@@ -1,0 +1,31 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Built from scratch on JAX/XLA (compute), Pallas (custom TPU kernels) and
+``jax.sharding``/pjit (parallelism).  The public surface mirrors Apache
+MXNet's (the reference at /root/reference — see SURVEY.md): ``mx.nd``,
+``mx.autograd``, ``mx.gluon``, ``mx.sym``/``mx.mod``, ``mx.kv``, ``mx.io``,
+``mx.optimizer``, ``mx.metric``, ``mx.init`` — but the architecture is
+TPU-first, not a port: no dependency engine (JAX async dispatch + XLA),
+no hand-written kernels (XLA fusion + Pallas for hot spots), no ps-lite
+(XLA collectives over ICI/DCN).
+"""
+from __future__ import annotations
+
+from .base import MXNetError, __version__
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+
+from . import base
+from . import context
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+
+# convenience re-exports matching `import mxnet as mx` usage
+from .ndarray import NDArray
+
+__all__ = [
+    "MXNetError", "Context", "cpu", "gpu", "tpu", "cpu_pinned",
+    "current_context", "num_gpus", "num_tpus", "nd", "ndarray",
+    "autograd", "random", "NDArray",
+]

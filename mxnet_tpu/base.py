@@ -1,0 +1,55 @@
+"""Base utilities: errors, dtype handling, env-var config.
+
+TPU-native analogue of the reference's `python/mxnet/base.py` (ctypes bridge,
+error handling) and the `dmlc::GetEnv` config tier (reference
+`docs/faq/env_var.md:35-315`).  There is no C ABI boundary here — the compute
+substrate is JAX/XLA — so "base" reduces to dtype/version/env plumbing.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "mx_real_t",
+    "get_env",
+]
+
+__version__ = "0.1.0"
+
+
+class MXNetError(RuntimeError):
+    """Default error type raised by the framework (reference: base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, onp.generic)
+integer_types = (int, onp.integer)
+
+# Default real dtype (reference: mx_real_t = np.float32)
+mx_real_t = onp.float32
+
+_TRUE = {"1", "true", "yes", "on"}
+
+
+def get_env(name: str, default=None, typ=str):
+    """Read an ``MXNET_*``-style environment variable with a typed default.
+
+    Analogue of ``dmlc::GetEnv`` (used throughout the reference's C++ core).
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is bool:
+        return val.lower() in _TRUE
+    return typ(val)
+
+
+def check_call(ret):  # pragma: no cover - API-parity shim
+    """No-op C-API parity shim: there is no C return code to check."""
+    return ret

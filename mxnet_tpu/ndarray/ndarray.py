@@ -1,0 +1,659 @@
+"""NDArray: imperative, device-placed tensor over an immutable ``jax.Array``.
+
+TPU-native redesign of the reference's NDArray
+(``include/mxnet/ndarray.h:82`` + ``python/mxnet/ndarray/ndarray.py:175``).
+The reference's NDArray is a ref-counted chunk + dependency-engine variable;
+mutation is natural and ordering is enforced by the engine's var queues.  On
+TPU the substrate (jax.Array) is immutable and async-by-construction, so:
+
+* **mutation** (``+=``, ``a[:]=``, ``out=``) is implemented by *rebinding*
+  the underlying buffer (``self._data = new_value``) — XLA donation makes
+  this allocation-free inside jitted code, and JAX's async dispatch plays
+  the role of the dependency engine (SURVEY.md §7 translation table row 1);
+* **versioning/dep-tracking** is free: recorded tape nodes capture input
+  *values*, so later mutation cannot corrupt autograd state;
+* **ordering**: ``wait_to_read`` = ``block_until_ready``; python never
+  blocks until a value is observed (``asnumpy``/``asscalar``), exactly the
+  reference's laziness contract (ndarray.py:157 ``waitall``).
+
+Ops dispatch through the op registry (``ops/registry.py``); every op is a
+pure JAX function, so the same NDArray code runs eagerly (per-op XLA
+dispatch — the Imperative::Invoke analogue, imperative.cc:89) and under
+``jax.jit`` tracing (the CachedOp/hybridize analogue) without change.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd
+from ..base import MXNetError, numeric_types
+from ..context import Context, current_context
+from ..ops.registry import get_op
+
+__all__ = [
+    "NDArray", "array", "empty", "zeros", "ones", "full", "arange", "linspace",
+    "eye", "concat", "stack", "add_n", "split", "waitall", "invoke_fn",
+    "from_numpy", "from_jax",
+]
+
+
+def _ctx_of(values_ctx: Optional[Context]) -> Context:
+    return values_ctx if values_ctx is not None else current_context()
+
+
+class NDArray:
+    """A multi-dimensional array on a device context.
+
+    Reference surface: ``python/mxnet/ndarray/ndarray.py:175``.
+    """
+
+    # make NDArray win against numpy's ufunc dispatch in np_scalar * nd cases
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        ctx = _ctx_of(ctx)
+        if isinstance(data, NDArray):
+            data = data._data
+        if dtype is not None:
+            data = jnp.asarray(data, dtype=dtype)
+        else:
+            data = jnp.asarray(data)
+        self._data = jax.device_put(data, ctx.jax_device)
+        self._ctx = ctx
+        self._ag = None  # autograd.AGInfo when recorded / marked
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return "default"  # dense; sparse storage is emulated (SURVEY §2.2 row 4)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        """The gradient buffer attached by ``attach_grad`` (reference
+        ndarray.py grad property)."""
+        ag = self._ag
+        return ag.grad if ag is not None else None
+
+    # ------------------------------------------------------------------
+    # conversion / blocking
+    # ------------------------------------------------------------------
+    def asnumpy(self) -> onp.ndarray:
+        """Copy to host numpy array. Blocks until the value is ready
+        (reference ndarray.py asnumpy — the synchronisation point)."""
+        return onp.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def asjax(self) -> jax.Array:
+        """The underlying jax.Array (TPU-native escape hatch)."""
+        return self._data
+
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        if not copy and onp.dtype(dtype) == self.dtype:
+            return self
+        return invoke_fn(lambda x: x.astype(onp.dtype(dtype)), [self], name="cast")
+
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        arr = self.asnumpy() if not _is_tracer(self._data) else self._data
+        return "\n%s\n<NDArray %s @%s>" % (arr, "x".join(map(str, self.shape)), self._ctx)
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # ------------------------------------------------------------------
+    # placement / copies
+    # ------------------------------------------------------------------
+    def copy(self) -> "NDArray":
+        return invoke_fn(jnp.copy, [self], name="_copy")
+
+    def copyto(self, other):
+        """Copy into another NDArray (rebind) or to a Context (new array).
+        Reference ndarray.py copyto / ``CopyFromTo``."""
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other._ctx.jax_device)
+            return other
+        elif isinstance(other, Context):
+            return _wrap(jax.device_put(self._data, other.jax_device), other)
+        raise TypeError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def detach(self) -> "NDArray":
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Allocate a gradient buffer and mark this array as a variable
+        (reference ndarray.py attach_grad → MXAutogradMarkVariables)."""
+        grad = _wrap(jnp.zeros(self.shape, self.dtype), self._ctx)
+        autograd.mark_variables([self], [grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph: bool = False, train_mode: bool = True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # reshape with MXNet's special codes (0, -1, -2, -3, -4)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        """MXNet reshape semantics (reference ndarray.py reshape):
+        0 copy input dim; -1 infer; -2 copy all remaining dims; -3 merge two
+        consecutive dims; -4 split a dim (followed by the two factors)."""
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape") is not None and not shape:
+            shape = tuple(kwargs["shape"])
+        reverse = kwargs.get("reverse", False)
+        new_shape = _infer_reshape(self.shape, shape, reverse)
+        return invoke_fn(lambda x: jnp.reshape(x, new_shape), [self], name="reshape")
+
+    def reshape_like(self, other: "NDArray") -> "NDArray":
+        return self.reshape(other.shape)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, key):
+        key, arrays = _split_index(key)
+
+        def fn(x, *idx_arrays):
+            k = _rebuild_index(key, list(idx_arrays))
+            return x[k]
+
+        return invoke_fn(fn, [self] + arrays, name="_slice")
+
+    def __setitem__(self, key, value):
+        if autograd.is_recording() and self._ag is not None:
+            raise MXNetError(
+                "in-place assignment to an array that requires grad is not "
+                "supported while recording (matches reference restriction)")
+        key, arrays = _split_index(key)
+        vals = [a._data for a in arrays]
+        k = _rebuild_index(key, vals)
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, onp.ndarray):
+            value = jnp.asarray(value)
+        if k == slice(None) or (isinstance(k, tuple) and all(e == slice(None) for e in k)):
+            # full assignment: a[:] = v  → rebind with broadcast
+            self._data = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
+            return
+        self._data = self._data.at[k].set(value)
+
+    # ------------------------------------------------------------------
+    # arithmetic operators (reference: ndarray.py operator section)
+    # ------------------------------------------------------------------
+    def _binop(self, other, fn, name):
+        if isinstance(other, NDArray):
+            return invoke_fn(fn, [self, other], name=name)
+        if isinstance(other, numeric_types):
+            return invoke_fn(lambda x: fn(x, other), [self], name=name + "_scalar")
+        if isinstance(other, (onp.ndarray, list, tuple)):
+            return invoke_fn(fn, [self, array(other, ctx=self._ctx)], name=name)
+        return NotImplemented
+
+    def _rbinop(self, other, fn, name):
+        if isinstance(other, numeric_types):
+            return invoke_fn(lambda x: fn(other, x), [self], name="_r" + name + "_scalar")
+        if isinstance(other, (onp.ndarray, list, tuple)):
+            return invoke_fn(fn, [array(other, ctx=self._ctx), self], name=name)
+        return NotImplemented
+
+    def __add__(self, o):  return self._binop(o, jnp.add, "_plus")
+    def __radd__(self, o): return self.__add__(o)
+    def __sub__(self, o):  return self._binop(o, jnp.subtract, "_minus")
+    def __rsub__(self, o): return self._rbinop(o, jnp.subtract, "minus")
+    def __mul__(self, o):  return self._binop(o, jnp.multiply, "_mul")
+    def __rmul__(self, o): return self.__mul__(o)
+    def __truediv__(self, o):  return self._binop(o, jnp.divide, "_div")
+    def __rtruediv__(self, o): return self._rbinop(o, jnp.divide, "div")
+    def __floordiv__(self, o):  return self._binop(o, jnp.floor_divide, "_floordiv")
+    def __rfloordiv__(self, o): return self._rbinop(o, jnp.floor_divide, "floordiv")
+    def __mod__(self, o):  return self._binop(o, jnp.mod, "_mod")
+    def __rmod__(self, o): return self._rbinop(o, jnp.mod, "mod")
+    def __pow__(self, o):  return self._binop(o, jnp.power, "_power")
+    def __rpow__(self, o): return self._rbinop(o, jnp.power, "power")
+    def __matmul__(self, o): return self._binop(o, jnp.matmul, "_matmul")
+    def __neg__(self):  return invoke_fn(jnp.negative, [self], name="negative")
+    def __abs__(self):  return invoke_fn(jnp.abs, [self], name="abs")
+
+    def _inplace(self, res):
+        """In-place update = buffer rebind. A marked variable (attach_grad
+        leaf) KEEPS its marking — `w -= lr*w.grad` must not unmark `w`
+        (reference: optimizer updates mutate weights without touching
+        autograd state). Op outputs adopt the new tape link.  Mutating a
+        marked leaf while recording is rejected, matching the reference
+        ('Inplace operations are not supported when recording') and our
+        __setitem__ guard."""
+        if self._ag is not None and self._ag.node is None:
+            if autograd.is_recording():
+                raise MXNetError(
+                    "in-place operations on an array that requires grad are "
+                    "not supported while recording")
+            self._data = res._data
+            return self
+        self._data = res._data
+        self._ag = res._ag
+        return self
+
+    def __iadd__(self, o):  return self._inplace(self.__add__(o))
+    def __isub__(self, o):  return self._inplace(self.__sub__(o))
+    def __imul__(self, o):  return self._inplace(self.__mul__(o))
+    def __itruediv__(self, o): return self._inplace(self.__truediv__(o))
+    def __imod__(self, o):  return self._inplace(self.__mod__(o))
+
+    def __eq__(self, o):
+        r = self._binop(o, lambda a, b: (a == b).astype(self.dtype), "_equal")
+        return r
+    def __ne__(self, o):
+        return self._binop(o, lambda a, b: (a != b).astype(self.dtype), "_not_equal")
+    def __gt__(self, o):
+        return self._binop(o, lambda a, b: (a > b).astype(self.dtype), "_greater")
+    def __ge__(self, o):
+        return self._binop(o, lambda a, b: (a >= b).astype(self.dtype), "_greater_equal")
+    def __lt__(self, o):
+        return self._binop(o, lambda a, b: (a < b).astype(self.dtype), "_lesser")
+    def __le__(self, o):
+        return self._binop(o, lambda a, b: (a <= b).astype(self.dtype), "_lesser_equal")
+
+    __hash__ = object.__hash__  # identity hash, like the reference
+
+    # ------------------------------------------------------------------
+    # registry-backed methods: a.relu(), a.sum(axis=1), a.transpose() …
+    # mirrors the reference's codegen of NDArray methods from the op
+    # registry (python/mxnet/ndarray/register.py)
+    # ------------------------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        op = get_op(name)
+        if op is None:
+            raise AttributeError("NDArray has no attribute/op %r" % name)
+        from . import _make_op_func
+        f = _make_op_func(op)
+        return lambda *a, **kw: f(self, *a, **kw)
+
+    # a few methods with non-registry-friendly signatures
+    def transpose(self, axes=None):
+        if isinstance(axes, tuple) and len(axes) == 0:
+            axes = None
+        return invoke_fn(lambda x: jnp.transpose(x, axes), [self], name="transpose")
+
+    def flatten(self):
+        n = self.shape[0] if self.ndim > 0 else 1
+        return self.reshape((n, -1))
+
+    def squeeze(self, axis=None):
+        return invoke_fn(lambda x: jnp.squeeze(x, axis), [self], name="squeeze")
+
+    def expand_dims(self, axis):
+        return invoke_fn(lambda x: jnp.expand_dims(x, axis), [self], name="expand_dims")
+
+    def broadcast_to(self, shape):
+        cur = self.shape
+        if len(cur) < len(shape):
+            cur = (1,) * (len(shape) - len(cur)) + cur
+        return invoke_fn(lambda x: jnp.broadcast_to(x.reshape(cur), tuple(shape)),
+                         [self], name="broadcast_to")
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def zeros_like(self, **kw):
+        return invoke_fn(jnp.zeros_like, [self], name="zeros_like")
+
+    def ones_like(self, **kw):
+        return invoke_fn(jnp.ones_like, [self], name="ones_like")
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage types are not supported on TPU build yet")
+        return self
+
+    def tojson(self):
+        raise AttributeError("tojson is a Symbol method")
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _wrap(value, ctx: Optional[Context] = None) -> NDArray:
+    """Wrap a raw jax value in an NDArray without copying/placing."""
+    out = NDArray.__new__(NDArray)
+    out._data = value if isinstance(value, (jax.Array, jax.core.Tracer)) else jnp.asarray(value)
+    out._ctx = ctx if ctx is not None else current_context()
+    out._ag = None
+    return out
+
+
+def from_jax(value, ctx: Optional[Context] = None) -> NDArray:
+    return _wrap(value, ctx)
+
+
+def from_numpy(value, ctx: Optional[Context] = None) -> NDArray:
+    return array(value, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+
+def invoke_fn(fn, inputs: Sequence[NDArray], name: str = "", out=None,
+              n_outputs: Optional[int] = None, ctx: Optional[Context] = None,
+              record: bool = True):
+    """Execute a pure function on NDArray inputs; wrap + (maybe) record.
+
+    The analogue of ``Imperative::Invoke`` → ``PushFCompute``
+    (src/imperative/imperative.cc:89, imperative_utils.h:394): here "push to
+    engine" is simply calling into JAX — dispatch is already async.
+    ``record=False`` cuts non-differentiable ops cleanly out of the tape
+    (the FGradient-absent case in the reference).
+    """
+    datas = [i._data for i in inputs]
+    res = fn(*datas)
+    multiple = isinstance(res, (tuple, list))
+    out_vals = list(res) if multiple else [res]
+    if ctx is None:
+        ctx = inputs[0]._ctx if inputs else current_context()
+    outs = [_wrap(v, ctx) for v in out_vals]
+    if record and autograd.is_recording():
+        autograd.record_op(fn, list(inputs), outs, name=name)
+
+    def _write(dst, src):
+        # preserve a marked-leaf destination's grad buffer, like _inplace
+        dst._data = src._data
+        if dst._ag is None or dst._ag.node is not None:
+            dst._ag = src._ag
+
+    if out is not None:
+        if multiple:
+            for o, r in zip(out, outs):
+                _write(o, r)
+            return out
+        _write(out, outs[0])
+        return out
+    if multiple or (n_outputs is not None and n_outputs > 1):
+        return outs
+    return outs[0]
+
+
+# ---------------------------------------------------------------------------
+# reshape helper (MXNet special codes)
+# ---------------------------------------------------------------------------
+
+def _infer_reshape(cur_shape, target, reverse=False):
+    if reverse:
+        cur_shape = tuple(reversed(cur_shape))
+        target = tuple(reversed(target))
+    out: List[int] = []
+    src = list(cur_shape)
+    i = 0  # index into src
+    infer_at = None
+    t = 0
+    while t < len(target):
+        d = target[t]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            infer_at = len(out); out.append(1)
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            f1, f2 = target[t + 1], target[t + 2]
+            if f1 == -1:
+                f1 = src[i] // f2
+            if f2 == -1:
+                f2 = src[i] // f1
+            out.extend([f1, f2]); i += 1; t += 2
+        else:
+            out.append(int(d))
+            if i < len(src):
+                i += 1
+        t += 1
+    total = 1
+    for s in cur_shape:
+        total *= s
+    if infer_at is not None:
+        known = 1
+        for j, s in enumerate(out):
+            if j != infer_at:
+                known *= s
+        out[infer_at] = total // known
+    if reverse:
+        out = list(reversed(out))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# indexing helpers
+# ---------------------------------------------------------------------------
+
+class _IdxSlot:
+    """Placeholder for an NDArray index inside a static index template."""
+    __slots__ = ("pos",)
+    def __init__(self, pos): self.pos = pos
+
+
+def _split_index(key):
+    """Split an index expression into a static template + NDArray operands."""
+    arrays: List[NDArray] = []
+
+    def conv(k):
+        if isinstance(k, NDArray):
+            slot = _IdxSlot(len(arrays))
+            arrays.append(k)
+            return slot
+        if isinstance(k, onp.ndarray):
+            return jnp.asarray(k)
+        return k
+
+    if isinstance(key, tuple):
+        key = tuple(conv(k) for k in key)
+    else:
+        key = conv(key)
+    return key, arrays
+
+
+def _rebuild_index(key, vals):
+    def conv(k):
+        if isinstance(k, _IdxSlot):
+            v = vals[k.pos]
+            return v.astype(jnp.int32) if jnp.issubdtype(v.dtype, jnp.floating) else v
+        return k
+
+    if isinstance(key, tuple):
+        return tuple(conv(k) for k in key)
+    return conv(key)
+
+
+# ---------------------------------------------------------------------------
+# creation functions (reference: ndarray.py zeros/ones/full/array/arange…)
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = src.astype(dtype)
+        return _wrap(jax.device_put(src, _ctx_of(ctx).jax_device), _ctx_of(ctx))
+    if dtype is None:
+        dtype = source_array.dtype if isinstance(source_array, onp.ndarray) else onp.float32
+    arr = onp.asarray(source_array, dtype=dtype)
+    ctx = _ctx_of(ctx)
+    return _wrap(jax.device_put(jnp.asarray(arr), ctx.jax_device), ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx: Optional[Context] = None, dtype=None, **kw) -> NDArray:
+    ctx = _ctx_of(ctx)
+    dtype = onp.float32 if dtype is None else dtype
+    if isinstance(shape, numbers.Integral):
+        shape = (int(shape),)
+    return _wrap(jax.device_put(jnp.zeros(tuple(shape), dtype), ctx.jax_device), ctx)
+
+
+def ones(shape, ctx: Optional[Context] = None, dtype=None, **kw) -> NDArray:
+    ctx = _ctx_of(ctx)
+    dtype = onp.float32 if dtype is None else dtype
+    if isinstance(shape, numbers.Integral):
+        shape = (int(shape),)
+    return _wrap(jax.device_put(jnp.ones(tuple(shape), dtype), ctx.jax_device), ctx)
+
+
+def full(shape, val, ctx: Optional[Context] = None, dtype=None, out=None) -> NDArray:
+    ctx = _ctx_of(ctx)
+    dtype = onp.float32 if dtype is None else dtype
+    if isinstance(shape, numbers.Integral):
+        shape = (int(shape),)
+    res = _wrap(jax.device_put(jnp.full(tuple(shape), val, dtype), ctx.jax_device), ctx)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    ctx = _ctx_of(ctx)
+    dtype = onp.float32 if dtype is None else dtype
+    a = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return _wrap(jax.device_put(a, ctx.jax_device), ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None) -> NDArray:
+    ctx = _ctx_of(ctx)
+    dtype = onp.float32 if dtype is None else dtype
+    a = jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype)
+    return _wrap(jax.device_put(a, ctx.jax_device), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    ctx = _ctx_of(ctx)
+    dtype = onp.float32 if dtype is None else dtype
+    a = jnp.eye(N, M if M else None, k, dtype=dtype)
+    return _wrap(jax.device_put(a, ctx.jax_device), ctx)
+
+
+# multi-input ops with list signatures (reference exposes these as nd.concat etc.)
+def concat(*data, dim: int = 1, **kw) -> NDArray:
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return invoke_fn(lambda *xs: jnp.concatenate(xs, axis=dim), list(data), name="concat")
+
+
+def stack(*data, axis: int = 0, **kw) -> NDArray:
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return invoke_fn(lambda *xs: jnp.stack(xs, axis=axis), list(data), name="stack")
+
+
+def add_n(*args, **kw) -> NDArray:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    def fn(*xs):
+        s = xs[0]
+        for x in xs[1:]:
+            s = s + x
+        return s
+    return invoke_fn(fn, list(args), name="add_n")
+
+
+def split(data, num_outputs: int, axis: int = 1, squeeze_axis: bool = False):
+    """slice_channel / split (reference src/operator/slice_channel)."""
+    def fn(x):
+        parts = jnp.split(x, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    out = invoke_fn(fn, [data], name="split")
+    return out[0] if num_outputs == 1 else out
+
+
+def waitall():
+    """Block until all async computation is complete (reference
+    ndarray.py:157 — engine WaitForAll ⇒ here effectively a fence; individual
+    arrays are fenced by wait_to_read)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

@@ -1,0 +1,67 @@
+"""NDArray serialization: ``mx.nd.save`` / ``mx.nd.load``.
+
+Reference: ``python/mxnet/ndarray/utils.py:149-222`` + C++
+``src/ndarray/ndarray.cc`` Save/Load (magic + version binary format).
+Capability parity, TPU-native format: a single ``.npz`` container holding
+either a list (keys ``arr_0``…) or a dict of arrays — portable, fast, and
+mmap-friendly on TPU hosts.  ``.params`` files written by Gluon use the
+same container.
+"""
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+from typing import Dict, List, Union
+
+import numpy as onp
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load", "save_dict", "load_dict"]
+
+_LIST_PREFIX = "__mx_list__:"
+
+
+def save(fname: str, data) -> None:
+    """Save a list or str→NDArray dict (reference nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    payload: Dict[str, onp.ndarray] = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            if not isinstance(v, NDArray):
+                raise TypeError("save only supports NDArray values")
+            payload[k] = v.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            if not isinstance(v, NDArray):
+                raise TypeError("save only supports NDArray values")
+            payload[_LIST_PREFIX + str(i)] = v.asnumpy()
+    else:
+        raise TypeError("data needs to either be a NDArray, dict of str to NDArray")
+    onp.savez(fname if fname.endswith(".npz") else fname, **payload)
+    # numpy appends .npz; rename to the exact requested path
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname: str, ctx=None) -> Union[List[NDArray], Dict[str, NDArray]]:
+    """Load from ``save`` (reference nd.load)."""
+    with onp.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            items = sorted(keys, key=lambda k: int(k[len(_LIST_PREFIX):]))
+            return [array(z[k], ctx=ctx) for k in items]
+        return {k: array(z[k], ctx=ctx) for k in keys}
+
+
+def save_dict(fname: str, data: Dict[str, NDArray]) -> None:
+    save(fname, data)
+
+
+def load_dict(fname: str, ctx=None) -> Dict[str, NDArray]:
+    out = load(fname, ctx=ctx)
+    if isinstance(out, list):
+        return {str(i): v for i, v in enumerate(out)}
+    return out

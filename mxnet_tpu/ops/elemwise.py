@@ -1,0 +1,209 @@
+"""Elementwise unary/binary/scalar operators.
+
+Reference: ``src/operator/tensor/elemwise_*`` + the kernel functor zoo
+``src/operator/mshadow_op.h`` (the canonical list of required math
+functions — SURVEY.md §2.2 row 1).  Every kernel here is a jnp/lax
+composition; XLA fuses them into surrounding matmuls on TPU, which is the
+whole point — no hand-written elementwise kernels needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from .registry import register, alias
+
+# --- unary table -----------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "reciprocal": jnp.reciprocal,
+    "negative": jnp.negative,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "erf": jsp.erf,
+    "erfinv": jsp.erfinv,
+    "gamma": lambda x: jnp.exp(jsp.gammaln(x)),
+    "gammaln": jsp.gammaln,
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+    "softsign": jax.nn.soft_sign,
+    "identity": lambda x: x,
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(_fn)
+
+alias("identity", "_copy", "stop_gradient_identity", "BlockGrad_inner")
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(x, alpha: float = 0.2, beta: float = 0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("clip")
+def clip(data, a_min: float = None, a_max: float = None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("BlockGrad", differentiable=False, aliases=("stop_gradient",))
+def block_grad(data):
+    return jax.lax.stop_gradient(data)
+
+
+@register("make_loss")
+def make_loss(data, grad_scale: float = 1.0, valid_thresh: float = 0.0,
+              normalization: str = "null"):
+    # reference src/operator/make_loss: forward is IDENTITY; grad_scale only
+    # scales the backward seed. data*s - sg(data*(s-1)) has value `data` and
+    # gradient `s`.
+    if grad_scale == 1.0:
+        return data
+    return data * grad_scale - jax.lax.stop_gradient(data * (grad_scale - 1.0))
+
+
+# --- binary table ----------------------------------------------------------
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+    "ldexp": jnp.ldexp,
+    "power": jnp.power,
+    "mod": jnp.mod,
+}
+
+for _name, _fn in _BINARY.items():
+    register(_name)(_fn)
+
+alias("broadcast_add", "broadcast_plus", "_add", "_plus")
+alias("broadcast_sub", "broadcast_minus", "_sub", "_minus")
+alias("broadcast_mul", "_mul")
+alias("broadcast_div", "_div")
+alias("broadcast_power", "_power", "pow")
+alias("broadcast_mod", "_mod")
+
+
+def _cmp(fn):
+    return lambda a, b: fn(a, b).astype(jnp.result_type(a))
+
+
+for _name, _fn in {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+}.items():
+    register(_name, differentiable=False)(_cmp(_fn))
+
+alias("broadcast_equal", "equal")
+alias("broadcast_not_equal", "not_equal")
+alias("broadcast_greater", "greater")
+alias("broadcast_greater_equal", "greater_equal")
+alias("broadcast_lesser", "lesser")
+alias("broadcast_lesser_equal", "lesser_equal")
+
+
+for _name, _fn in {
+    "broadcast_logical_and": lambda a, b: ((a != 0) & (b != 0)),
+    "broadcast_logical_or": lambda a, b: ((a != 0) | (b != 0)),
+    "broadcast_logical_xor": lambda a, b: ((a != 0) ^ (b != 0)),
+}.items():
+    register(_name, differentiable=False)(_cmp(_fn))
+
+alias("broadcast_logical_and", "logical_and")
+alias("broadcast_logical_or", "logical_or")
+alias("broadcast_logical_xor", "logical_xor")
+
+
+# --- scalar variants (reference elemwise_binary_scalar_op) -----------------
+def _scalar_op(fn, swap=False):
+    def k(data, scalar: float = 0.0):
+        return fn(scalar, data) if swap else fn(data, scalar)
+    return k
+
+
+for _name, _fn, _swap in [
+    ("_plus_scalar", jnp.add, False),
+    ("_minus_scalar", jnp.subtract, False),
+    ("_rminus_scalar", jnp.subtract, True),
+    ("_mul_scalar", jnp.multiply, False),
+    ("_div_scalar", jnp.divide, False),
+    ("_rdiv_scalar", jnp.divide, True),
+    ("_mod_scalar", jnp.mod, False),
+    ("_rmod_scalar", jnp.mod, True),
+    ("_power_scalar", jnp.power, False),
+    ("_rpower_scalar", jnp.power, True),
+    ("_maximum_scalar", jnp.maximum, False),
+    ("_minimum_scalar", jnp.minimum, False),
+    ("_hypot_scalar", jnp.hypot, False),
+]:
+    register(_name)(_scalar_op(_fn, _swap))
+
+for _name, _fn in [
+    ("_equal_scalar", jnp.equal),
+    ("_not_equal_scalar", jnp.not_equal),
+    ("_greater_scalar", jnp.greater),
+    ("_greater_equal_scalar", jnp.greater_equal),
+    ("_lesser_scalar", jnp.less),
+    ("_lesser_equal_scalar", jnp.less_equal),
+]:
+    register(_name, differentiable=False)(_scalar_op(_cmp(_fn)))
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition != 0, x, y)
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar: float = 1.0):
+    s2 = scalar * scalar
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * data * data, a - 0.5 / s2)

@@ -1,0 +1,346 @@
+"""Matrix / shape-manipulation / indexing / ordering operators.
+
+Reference: ``src/operator/tensor/matrix_op*`` (dot, batch_dot, transpose,
+reshape, slice, concat/stack, take, repeat, tile, flip, clip…),
+``ordering_op`` (topk/sort/argsort), ``indexing_op`` (embedding, take,
+one_hot, gather/scatter), ``init_op``, ``diag_op`` — SURVEY.md §2.2 row 3.
+All dots map straight to the MXU via XLA dot_general.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    a = lhs.T if transpose_a and lhs.ndim == 2 else (jnp.transpose(lhs) if transpose_a else lhs)
+    b = rhs.T if transpose_b and rhs.ndim == 2 else (jnp.transpose(rhs) if transpose_b else rhs)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b (tensordot-1)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    if axes is not None and len(tuple(axes)) == 0:
+        axes = None
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1: int = 0, dim2: int = 0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(data, axis: int = 0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("slice")
+def slice_op(data, begin=(), end=(), step=()):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return data[tuple(idx)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis: int = 0, begin: int = 0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(shape_like.ndim))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("take")
+def take(a, indices, axis: int = 0, mode: str = "clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("pick")
+def pick(data, index, axis: int = -1, keepdims: bool = False, mode: str = "clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    return picked if keepdims else jnp.squeeze(picked, axis=axis)
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth: int = 0, on_value: float = 1.0,
+            off_value: float = 0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), data.dtype)
+    return out.at[idx].add(data)
+
+
+@register("repeat")
+def repeat(data, repeats: int = 1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, axis=()):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=ax)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, mode: str = "constant", pad_width=(), constant_value: float = 0.0):
+    pw = []
+    for i in range(0, len(pad_width), 2):
+        pw.append((pad_width[i], pad_width[i + 1]))
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    return jnp.pad(data, pw, mode="reflect")
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.array(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.array([data.size], dtype=jnp.int64)
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("diag")
+def diag(data, k: int = 0, axis1: int = 0, axis2: int = 1):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+# --- ordering (reference src/operator/tensor/ordering_op) ------------------
+@register("topk", differentiable=False)
+def topk(data, axis: int = -1, k: int = 1, ret_typ: str = "indices",
+         is_ascend: bool = False, dtype="float32"):
+    x = data if not is_ascend else -data
+    x = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        x2 = jnp.moveaxis(data if not is_ascend else -data, axis, -1)
+        kth = jnp.sort(x2, axis=-1)[..., -k][..., None]
+        mask = (x2 >= kth).astype(data.dtype)
+        return jnp.moveaxis(mask, -1, axis)
+    if ret_typ != "indices":
+        raise ValueError("topk: unknown ret_typ %r" % ret_typ)
+    return idx
+
+
+@register("sort")
+def sort(data, axis: int = -1, is_ascend: bool = True):
+    s = jnp.sort(data, axis=axis)
+    return s if is_ascend else jnp.flip(s, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(data, axis: int = -1, is_ascend: bool = True, dtype="float32"):
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.dtype(dtype))
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size: int = 1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size: int = 1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("tril")
+def tril(data, k: int = 0):
+    return jnp.tril(data, k)
+
+
+@register("histogram", differentiable=False, num_outputs=2)
+def histogram(data, bin_cnt=None, range=None):
+    h, edges = jnp.histogram(data, bins=bin_cnt or 10, range=range)
+    return h.astype(jnp.float32), edges
+
+
+@register("boolean_mask", differentiable=False)
+def boolean_mask(data, index, axis: int = 0):
+    # dynamic shape in the reference (contrib/boolean_mask); on TPU we keep
+    # static shapes: compress via sort trick is out of scope — fall back to
+    # host computation (matches reference capability; not jittable).
+    import numpy as onp
+    mask = onp.asarray(index) != 0
+    return jnp.compress(mask, data, axis=axis)
+
+
+# --- linalg (reference la_op / linalg_impl.h → jnp.linalg) -----------------
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a: bool = False, transpose_b: bool = False,
+                alpha: float = 1.0, beta: float = 1.0, axis: int = -3):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a: bool = False, transpose_b: bool = False,
+                 alpha: float = 1.0, axis: int = -3):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(A):
+    L = A
+    inv = jnp.linalg.inv(jnp.matmul(L, jnp.swapaxes(L, -1, -2)))
+    return inv
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose: bool = False, rightside: bool = False,
+                lower: bool = True, alpha: float = 1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose: bool = False, rightside: bool = False,
+                lower: bool = True, alpha: float = 1.0):
+    import jax.scipy.linalg as jsl
+    a = A
+    sol = jsl.solve_triangular(a, alpha * B, trans=1 if transpose else 0,
+                               lower=lower, left_side=not rightside)
+    return sol
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose: bool = False, alpha: float = 1.0):
+    a_t = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(a_t, A) if transpose else jnp.matmul(A, a_t))
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset: int = 0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(A, offset: int = 0):
+    return jnp.vectorize(lambda v: jnp.diag(v, offset), signature="(n)->(m,m)")(A)
+
+
+@register("linalg_inverse")
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det")
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", num_outputs=2)
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet

@@ -1,0 +1,486 @@
+"""Neural-network core operators.
+
+Reference: ``src/operator/nn/`` (~29k LoC: convolution, fully_connected,
+batch_norm, layer_norm, pooling, softmax, dropout, …) plus the cuDNN/MKLDNN
+backends it dispatches to (SURVEY.md §2.2 rows 5-7).  TPU-native: every
+kernel is a lax/jnp composition lowered by XLA onto the MXU (convs/matmuls)
+with elementwise epilogues fused — the role cuDNN algorithm selection plays
+on GPU is played by XLA autotuning here, for free.
+
+Layout note: the public API keeps the reference's NCHW default; XLA's layout
+assignment re-tiles for the TPU's native layouts internally.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+# ---------------------------------------------------------------------------
+# dense / conv
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden: int = 0,
+                    no_bias: bool = False, flatten: bool = True):
+    """Reference src/operator/nn/fully_connected-inl.h: y = x·Wᵀ + b."""
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    y = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+def _conv_dn(ndim: int):
+    if ndim == 1:
+        return ("NCH", "OIH", "NCH")
+    if ndim == 2:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    return t if len(t) == n else t + (t[-1],) * (n - len(t))
+
+
+@register("Convolution", aliases=("convolution", "Convolution_v1"))
+def convolution(data, weight, bias=None, kernel=(), stride=None, dilate=None,
+                pad=None, num_filter: int = 0, num_group: int = 1,
+                no_bias: bool = False, cudnn_tune=None, cudnn_off: bool = False,
+                workspace: int = 1024, layout=None):
+    """Reference src/operator/nn/convolution-inl.h → lax.conv_general_dilated
+    (XLA conv lowers directly onto the MXU systolic array)."""
+    n = len(kernel) if kernel else data.ndim - 2
+    strides = _tup(stride, n)
+    dil = _tup(dilate, n)
+    pads = _tup(pad, n) if pad is not None else (0,) * n
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dil,
+        dimension_numbers=_conv_dn(n),
+        feature_group_count=num_group,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, kernel=(), stride=None, dilate=None,
+                  pad=None, adj=None, target_shape=None, num_filter: int = 0,
+                  num_group: int = 1, no_bias: bool = True, cudnn_tune=None,
+                  cudnn_off: bool = False, workspace: int = 512, layout=None):
+    """Transposed convolution (reference deconvolution-inl.h) via
+    lax.conv_transpose with IO-swapped kernel."""
+    n = len(kernel) if kernel else data.ndim - 2
+    strides = _tup(stride, n)
+    pads = _tup(pad, n) if pad is not None else (0,) * n
+    dil = _tup(dilate, n)
+    k = tuple(kernel)
+    # grad-of-conv formulation: conv_general_dilated with lhs_dilation
+    pad_cfg = [(d * (kk - 1) - p, d * (kk - 1) - p) for kk, p, d in zip(k, pads, dil)]
+    if adj is not None:
+        pad_cfg = [(lo, hi + a) for (lo, hi), a in zip(pad_cfg, _tup(adj, n))]
+    # weight layout in MXNet deconv: (in_channels, out_channels/group, *k)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if num_group > 1:
+        cin, cog = w.shape[0], w.shape[1]
+        w = w.reshape((num_group, cin // num_group, cog) + w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((num_group * cog, cin // num_group) + w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * n,
+        padding=pad_cfg,
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=_conv_dn(n),
+        feature_group_count=num_group,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+@register("Pooling", aliases=("pooling", "Pooling_v1"))
+def pooling(data, kernel=(), pool_type: str = "max", global_pool: bool = False,
+            stride=None, pad=None, pooling_convention: str = "valid",
+            cudnn_off: bool = False, p_value=None, count_include_pad=None,
+            layout=None):
+    """Reference src/operator/nn/pooling-inl.h via lax.reduce_window."""
+    n = data.ndim - 2
+    if global_pool:
+        ax = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=ax, keepdims=True)
+        return jnp.mean(data, axis=ax, keepdims=True)
+    k = _tup(kernel, n)
+    s = _tup(stride, n) if stride is not None else k
+    p = _tup(pad, n) if pad is not None else (0,) * n
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    if pooling_convention == "full":
+        # ceil-mode: pad high side enough that ceil-div windows fit
+        pads = [(0, 0), (0, 0)]
+        for i in range(n):
+            in_sz = data.shape[2 + i] + 2 * p[i]
+            out_sz = -(-(in_sz - k[i]) // s[i]) + 1  # ceil
+            needed = (out_sz - 1) * s[i] + k[i] - in_sz
+            pads.append((p[i], p[i] + max(needed, 0)))
+    else:
+        pads = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, dims, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, dims, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad is None or count_include_pad:
+            denom = 1
+            for kk in k:
+                denom *= kk
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return summed / counts
+    if pool_type == "lp":
+        pv = p_value or 2
+        powed = lax.reduce_window(jnp.abs(data) ** pv, 0.0, lax.add, dims, strides, pads)
+        return powed ** (1.0 / pv)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+@register("UpSampling")
+def upsampling(*data, scale: int = 1, sample_type: str = "nearest",
+               num_args: int = 1, num_filter: int = 0, multi_input_mode: str = "concat",
+               workspace: int = 512):
+    x = data[0]
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    else:  # bilinear
+        out = jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", num_outputs=3, needs_training=True,
+          aliases=("batch_norm", "BatchNorm_v1"))
+def batch_norm(data, gamma, beta, moving_mean, moving_var,
+               eps: float = 1e-3, momentum: float = 0.9,
+               fix_gamma: bool = True, use_global_stats: bool = False,
+               output_mean_var: bool = False, axis: int = 1,
+               cudnn_off: bool = False, training: bool = True):
+    """Reference src/operator/nn/batch_norm-inl.h.
+
+    Returns (out, batch_mean, batch_var); the moving-average update is done
+    by the caller (Gluon layer) — functional style, so the same kernel works
+    eagerly and under jit (aux-state updates become extra jit outputs).
+    """
+    ax = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if use_global_stats or not training:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=ax)
+        var = jnp.var(data, axis=ax)
+    shape = [1] * data.ndim
+    shape[axis % data.ndim] = data.shape[axis % data.ndim]
+    shp = tuple(shape)
+    out = (data - mean.reshape(shp)) * lax.rsqrt(var.reshape(shp) + eps) \
+        * g.reshape(shp) + beta.reshape(shp)
+    return out, lax.stop_gradient(mean), lax.stop_gradient(var)
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5,
+               output_mean_var: bool = False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, num_groups: int = 1, eps: float = 1e-5,
+               output_mean_var: bool = False):
+    n, c = data.shape[:2]
+    x = data.reshape((n, num_groups, c // num_groups) + data.shape[2:])
+    ax = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, c) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps: float = 1e-3):
+    ax = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("LRN", aliases=("lrn",))
+def lrn(data, nsize: int = 5, alpha: float = 1e-4, beta: float = 0.75, knorm: float = 2.0):
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(padded[:, i:i + data.shape[1]] for i in range(nsize))
+    return data / jnp.power(knorm + (alpha / nsize) * acc, beta)
+
+
+# ---------------------------------------------------------------------------
+# activations / softmax
+# ---------------------------------------------------------------------------
+
+@register("Activation", aliases=("activation",))
+def activation(data, act_type: str = "relu"):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type: str = "leaky", slope: float = 0.25,
+               lower_bound: float = 0.125, upper_bound: float = 0.334):
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        shape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
+        return jnp.where(data > 0, data, g.reshape(shape) * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return s * jnp.where(data > 0, data, a * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":  # eval-mode deterministic slope
+        return jnp.where(data > 0, data, (lower_bound + upper_bound) / 2 * data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("softmax")
+def softmax(data, length=None, axis: int = -1, temperature=None,
+            dtype=None, use_length: bool = False):
+    x = data / temperature if temperature else data
+    if length is not None and use_length:
+        idx = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = idx.reshape(shape) < jnp.expand_dims(length, axis)
+        x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if length is not None and use_length:
+        out = jnp.where(mask, out, 0.0)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("log_softmax")
+def log_softmax(data, axis: int = -1, temperature=None, dtype=None,
+                use_length: bool = False):
+    x = data / temperature if temperature else data
+    out = jax.nn.log_softmax(x, axis=axis)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("softmin")
+def softmin(data, axis: int = -1, temperature=None, dtype=None):
+    return softmax(-data, axis=axis, temperature=temperature, dtype=dtype)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode: str = "instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, preserve_shape, normalization, out_grad, smooth_alpha):
+    axis = 1 if (multi_output and data.ndim > 2) else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+@jax.custom_vjp
+def _softmax_output_core(data, label):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _smo_fwd(data, label):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label)
+
+
+def _smo_bwd(res, g):
+    out, label = res
+    oh = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1], dtype=out.dtype)
+    return ((out - oh), jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_smo_fwd, _smo_bwd)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output", "Softmax"))
+def softmax_output(data, label, grad_scale: float = 1.0, ignore_label: float = -1.0,
+                   multi_output: bool = False, use_ignore: bool = False,
+                   preserve_shape: bool = False, normalization: str = "null",
+                   out_grad: bool = False, smooth_alpha: float = 0.0):
+    """Reference src/operator/softmax_output-inl.h: forward = softmax; the
+    *backward* ignores the incoming head-grad and produces (p - onehot) —
+    implemented via custom_vjp (scaled by grad_scale)."""
+    if data.ndim > 2 and multi_output:
+        # (N, C, ...) softmax over C with per-position labels
+        x = jnp.moveaxis(data, 1, -1)
+        out = _softmax_output_core(x, label.reshape(x.shape[:-1]))
+        out = jnp.moveaxis(out, -1, 1)
+        return out
+    x = data.reshape(data.shape[0], -1)
+    out = _softmax_output_core(x, label.reshape(-1))
+    return out.reshape(data.shape) if preserve_shape else out
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=data.dtype)
+    return -jnp.sum(oh * logp)
+
+
+# ---------------------------------------------------------------------------
+# dropout (RNG op)
+# ---------------------------------------------------------------------------
+
+@register("Dropout", needs_rng=True, needs_training=True, aliases=("dropout",))
+def dropout(key, data, p: float = 0.5, mode: str = "training", axes=(),
+            cudnn_off: bool = True, training: bool = True):
+    """Reference src/operator/nn/dropout-inl.h (scaled/inverted dropout)."""
+    if not training and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    shape = list(data.shape)
+    if axes:
+        for a in axes:
+            shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# embedding / sequence ops
+# ---------------------------------------------------------------------------
+
+@register("Embedding")
+def embedding(data, weight, input_dim: int = 0, output_dim: int = 0,
+              dtype="float32", sparse_grad: bool = False):
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length: bool = False,
+                  value: float = 0.0, axis: int = 0):
+    """Reference src/operator/sequence_mask: data is (T, N, ...) (axis=0) or
+    (N, T, ...) (axis=1)."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    idx = jnp.arange(T)
+    if axis == 0:
+        shape = (T, 1) + (1,) * (data.ndim - 2)
+        lshape = (1, -1) + (1,) * (data.ndim - 2)
+    else:
+        shape = (1, T) + (1,) * (data.ndim - 2)
+        lshape = (-1, 1) + (1,) * (data.ndim - 2)
+    mask = idx.reshape(shape) < sequence_length.reshape(lshape)
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length: bool = False,
+                  axis: int = 0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, -1, axis=axis)
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, last.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length: bool = False,
+                     axis: int = 0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    idx = jnp.arange(T).reshape(-1, 1)
+    L = sequence_length.astype(jnp.int32).reshape(1, -1)
+    rev = jnp.where(idx < L, L - 1 - idx, idx)
+    return jnp.take_along_axis(data, rev.reshape(rev.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+@register("slice_channel", num_outputs=0, aliases=("SliceChannel",))
+def slice_channel(data, num_outputs: int = 1, axis: int = 1, squeeze_axis: bool = False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# losses as ops (reference loss/output group)
+# ---------------------------------------------------------------------------
+
+@register("LinearRegressionOutput", aliases=("linear_regression_output",))
+def linear_regression_output(data, label, grad_scale: float = 1.0):
+    return data  # forward identity; grad is (data-label) — handled by Gluon L2Loss
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale: float = 1.0):
+    return data
+
+
+@register("LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale: float = 1.0):
+    return jax.nn.sigmoid(data)

@@ -1,0 +1,132 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/random/sample_op`` etc. (SURVEY.md §2.2 row
+"Random", ~3.9k LoC) → ``jax.random``.  Every op takes a PRNG key as its
+first argument; the dispatcher injects it from ``mxnet_tpu.random`` state
+(stateful-seed parity, see that module's docstring).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("_random_uniform", needs_rng=True, differentiable=False,
+          aliases=("random_uniform", "uniform"))
+def random_uniform(key, low: float = 0.0, high: float = 1.0, shape=None,
+                   dtype="float32", ctx=None):
+    return jax.random.uniform(key, _shape(shape), jnp.dtype(dtype), low, high)
+
+
+@register("_random_normal", needs_rng=True, differentiable=False,
+          aliases=("random_normal", "normal"))
+def random_normal(key, loc: float = 0.0, scale: float = 1.0, shape=None,
+                  dtype="float32", ctx=None):
+    return loc + scale * jax.random.normal(key, _shape(shape), jnp.dtype(dtype))
+
+
+@register("_random_gamma", needs_rng=True, differentiable=False,
+          aliases=("random_gamma",))
+def random_gamma(key, alpha: float = 1.0, beta: float = 1.0, shape=None,
+                 dtype="float32", ctx=None):
+    return jax.random.gamma(key, alpha, _shape(shape), jnp.dtype(dtype)) * beta
+
+
+@register("_random_exponential", needs_rng=True, differentiable=False,
+          aliases=("random_exponential",))
+def random_exponential(key, lam: float = 1.0, shape=None, dtype="float32", ctx=None):
+    return jax.random.exponential(key, _shape(shape), jnp.dtype(dtype)) / lam
+
+
+@register("_random_poisson", needs_rng=True, differentiable=False,
+          aliases=("random_poisson",))
+def random_poisson(key, lam: float = 1.0, shape=None, dtype="float32", ctx=None):
+    return jax.random.poisson(key, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_negative_binomial", needs_rng=True, differentiable=False,
+          aliases=("random_negative_binomial",))
+def random_negative_binomial(key, k: int = 1, p: float = 1.0, shape=None,
+                             dtype="float32", ctx=None):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    g = jax.random.gamma(key, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(key, 1), g, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial", needs_rng=True, differentiable=False,
+          aliases=("random_generalized_negative_binomial",))
+def random_gen_neg_binomial(key, mu: float = 1.0, alpha: float = 1.0, shape=None,
+                            dtype="float32", ctx=None):
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    g = jax.random.gamma(key, r, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(key, 1), g, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_randint", needs_rng=True, differentiable=False,
+          aliases=("random_randint", "randint"))
+def random_randint(key, low: int = 0, high: int = 1, shape=None,
+                   dtype="int32", ctx=None):
+    return jax.random.randint(key, _shape(shape), low, high, jnp.dtype(dtype))
+
+
+@register("_sample_multinomial", needs_rng=True, differentiable=False,
+          aliases=("sample_multinomial", "multinomial"))
+def sample_multinomial(key, data, shape=None, get_prob: bool = False, dtype="int32"):
+    n = _shape(shape)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if n:
+        draws = jax.random.categorical(key, logits, axis=-1,
+                                       shape=n + logits.shape[:-1])
+        draws = jnp.moveaxis(draws, tuple(range(len(n))), tuple(range(-len(n), 0)))
+    else:
+        draws = jax.random.categorical(key, logits, axis=-1)
+    return draws.astype(jnp.dtype(dtype))
+
+
+@register("shuffle", needs_rng=True, differentiable=False, aliases=("_shuffle",))
+def shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+# --- broadcastable per-element-parameter samplers (reference multisample) --
+@register("_sample_uniform", needs_rng=True, differentiable=False,
+          aliases=("sample_uniform",))
+def sample_uniform(key, low, high, shape=None, dtype="float32"):
+    s = _shape(shape)
+    out_shape = low.shape + s
+    u = jax.random.uniform(key, out_shape, jnp.dtype(dtype))
+    low_b = low.reshape(low.shape + (1,) * len(s))
+    high_b = high.reshape(high.shape + (1,) * len(s))
+    return low_b + u * (high_b - low_b)
+
+
+@register("_sample_normal", needs_rng=True, differentiable=False,
+          aliases=("sample_normal",))
+def sample_normal(key, mu, sigma, shape=None, dtype="float32"):
+    s = _shape(shape)
+    out_shape = mu.shape + s
+    z = jax.random.normal(key, out_shape, jnp.dtype(dtype))
+    mu_b = mu.reshape(mu.shape + (1,) * len(s))
+    sg_b = sigma.reshape(sigma.shape + (1,) * len(s))
+    return mu_b + z * sg_b
+
+
+@register("_sample_gamma", needs_rng=True, differentiable=False,
+          aliases=("sample_gamma",))
+def sample_gamma(key, alpha, beta, shape=None, dtype="float32"):
+    s = _shape(shape)
+    a_b = alpha.reshape(alpha.shape + (1,) * len(s))
+    b_b = beta.reshape(beta.shape + (1,) * len(s))
+    g = jax.random.gamma(key, jnp.broadcast_to(a_b, alpha.shape + s))
+    return (g * b_b).astype(jnp.dtype(dtype))

@@ -1,0 +1,124 @@
+"""Reduction and broadcasting operators.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op_{value,index}``
+(SURVEY.md §2.2 row 2): sum/mean/prod/min/max/norm/argmax/argmin,
+broadcast_to/axis, nan-variants, keepdims/exclude semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+def _norm_axis(axis, ndim: int, exclude: bool = False):
+    if axis is None:
+        ax = None
+    elif isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(axis)
+    if exclude:
+        full = set(range(ndim))
+        inc = set((a + ndim) % ndim for a in (ax or ()))
+        ax = tuple(sorted(full - inc))
+    return ax
+
+
+def _reduce(fn):
+    def k(data, axis=None, keepdims: bool = False, exclude: bool = False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return fn(data, axis=ax, keepdims=keepdims)
+    return k
+
+
+for _name, _fn in {
+    "sum": jnp.sum,
+    "mean": jnp.mean,
+    "prod": jnp.prod,
+    "min": jnp.min,
+    "max": jnp.max,
+    "nansum": jnp.nansum,
+    "nanprod": jnp.nanprod,
+}.items():
+    register(_name)(_reduce(_fn))
+
+alias("sum", "sum_axis")
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@register("norm")
+def norm(data, ord: int = 2, axis=None, keepdims: bool = False):
+    ax = axis if axis is None or isinstance(axis, tuple) else (axis,)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def argmax(data, axis=None, keepdims: bool = False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)  # reference returns real dtype
+
+
+@register("argmin", differentiable=False)
+def argmin(data, axis=None, keepdims: bool = False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("broadcast_axis")
+def broadcast_axis(data, axis=(), size=()):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    sz = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(ax, sz):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+alias("broadcast_axis", "broadcast_axes")
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    tgt = tuple(int(s) if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        cur = lhs.shape
+        if len(cur) < rhs.ndim:
+            cur = (1,) * (rhs.ndim - len(cur)) + tuple(cur)
+        return jnp.broadcast_to(lhs.reshape(cur), rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps: float = 1e-10, mode: str = "instance"):
+    # reference src/operator/l2_normalization-inl.h
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / nrm
+
+
+@register("moments", num_outputs=2)
+def moments(data, axes=None, keepdims: bool = False):
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    var = jnp.var(data, axis=ax, keepdims=keepdims)
+    return mean, var

@@ -1,0 +1,84 @@
+"""Declarative operator registry.
+
+TPU-native replacement for the reference's NNVM op registry
+(``NNVM_REGISTER_OP``, ~304 sites under ``src/operator/``; interface
+``include/mxnet/op_attr_types.h:207-294``).  In the reference an op carries
+FCompute kernels per device plus inference/gradient attributes; here an op is
+a **pure JAX function** ``fn(*arrays, **attrs) -> array | tuple`` — shape and
+dtype inference come from ``jax.eval_shape``, gradients from ``jax.vjp``,
+device kernels from XLA.  What remains worth registering:
+
+* the *name/signature surface* (the compatibility contract with mx.nd.*),
+* output arity,
+* whether the op is differentiable / random (needs an RNG key),
+* aliases (the reference exposes many ops under several names).
+
+Ops registered here are automatically exposed as ``mx.nd.<name>`` functions
+and as ``NDArray`` methods, mirroring the reference's import-time codegen
+(``python/mxnet/ndarray/register.py:31-43``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]
+
+
+class OpDef:
+    """A registered operator."""
+
+    __slots__ = ("name", "fn", "num_outputs", "differentiable", "needs_rng",
+                 "needs_training", "doc")
+
+    def __init__(self, name: str, fn: Callable, num_outputs: int = 1,
+                 differentiable: bool = True, needs_rng: bool = False,
+                 needs_training: bool = False, doc: Optional[str] = None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.needs_rng = needs_rng
+        self.needs_training = needs_training
+        self.doc = doc or (fn.__doc__ if fn is not None else None)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+_OPS: Dict[str, OpDef] = {}
+
+
+def register(name: str, *, num_outputs: int = 1, differentiable: bool = True,
+             needs_rng: bool = False, needs_training: bool = False,
+             aliases: Sequence[str] = ()):
+    """Decorator registering a pure function as an operator.
+
+    The function signature is ``fn(*input_arrays, **attrs)``; attrs must be
+    hashable/static (they become trace-time constants under jit), mirroring
+    the reference's dmlc::Parameter op attributes.
+    """
+
+    def _reg(fn: Callable) -> Callable:
+        op = OpDef(name, fn, num_outputs=num_outputs,
+                   differentiable=differentiable, needs_rng=needs_rng,
+                   needs_training=needs_training)
+        _OPS[name] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fn
+
+    return _reg
+
+
+def alias(existing: str, *names: str) -> None:
+    op = _OPS[existing]
+    for n in names:
+        _OPS[n] = op
+
+
+def get_op(name: str) -> Optional[OpDef]:
+    return _OPS.get(name)
+
+
+def list_ops():
+    return sorted(_OPS.keys())

@@ -1,0 +1,69 @@
+"""Random number state: MXNet stateful-seed semantics over JAX PRNG keys.
+
+The reference keeps per-device Philox generator state
+(``include/mxnet/random_generator.h``, ``src/resource.cc`` kRandom resource)
+seeded by ``mx.random.seed``.  JAX PRNG is stateless; we hide explicit key
+threading behind the same API (SURVEY.md §7 "RNG parity" hard-part):
+
+* a thread-local root key advanced by splitting on every random-op call;
+* ``seed()`` resets it (per-process; ctx arg accepted for API parity);
+* a *key-supplier stack*: traced code (hybridized blocks / jitted train
+  steps) pushes a supplier producing keys derived from a traced key so each
+  compiled call sees fresh randomness — the analogue of the reference's
+  per-forward dropout state resource.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import jax
+
+__all__ = ["seed", "next_key", "key_supply", "current_key_supplier"]
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.suppliers: List[Callable[[], jax.Array]] = []
+
+
+_STATE = _RngState()
+
+
+def seed(seed_state: int, ctx: str = "all") -> None:
+    """Seed the global RNG (reference ``mx.random.seed``; ctx accepted for
+    API parity — all devices share one functional key stream here)."""
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key() -> jax.Array:
+    """Return a fresh PRNG key, advancing the state."""
+    if _STATE.suppliers:
+        return _STATE.suppliers[-1]()
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+class key_supply:
+    """Context manager installing a key supplier (used while tracing)."""
+
+    def __init__(self, base_key):
+        self._base = base_key
+        self._count = 0
+
+    def _next(self):
+        self._count += 1
+        return jax.random.fold_in(self._base, self._count)
+
+    def __enter__(self):
+        _STATE.suppliers.append(self._next)
+        return self
+
+    def __exit__(self, *a):
+        _STATE.suppliers.pop()
+        return False
+
+
+def current_key_supplier() -> Optional[Callable]:
+    return _STATE.suppliers[-1] if _STATE.suppliers else None

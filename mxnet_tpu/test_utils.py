@@ -1,0 +1,135 @@
+"""Test harness utilities.
+
+Reference: ``python/mxnet/test_utils.py`` — ``default_context`` (:53),
+``assert_almost_equal`` (:489), ``check_numeric_gradient`` (finite
+differences vs autograd, :860), ``check_consistency`` (:1283 — cross-backend
+oracle; here CPU↔TPU), ``rand_ndarray``, ``same``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as onp
+
+from . import autograd
+from . import context as _context
+from .ndarray import NDArray, array
+from .ndarray import ndarray as _nd_mod
+
+__all__ = [
+    "default_context", "set_default_context", "assert_almost_equal", "same",
+    "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
+    "check_numeric_gradient", "check_consistency", "simple_forward",
+]
+
+_DEFAULT_CTX: Optional[_context.Context] = None
+
+
+def default_context() -> _context.Context:
+    return _DEFAULT_CTX if _DEFAULT_CTX is not None else _context.current_context()
+
+
+def set_default_context(ctx: _context.Context) -> None:
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def _as_numpy(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+def same(a, b) -> bool:
+    return onp.array_equal(_as_numpy(a), _as_numpy(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20) -> bool:
+    return onp.allclose(_as_numpy(a), _as_numpy(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    a, b = _as_numpy(a), _as_numpy(b)
+    if not onp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True):
+        err = onp.max(onp.abs(a - b))
+        rel = onp.max(onp.abs(a - b) / (onp.abs(b) + 1e-12))
+        raise AssertionError(
+            "%s and %s differ: max abs err %g, max rel err %g (rtol=%g atol=%g)\n%s\n%s"
+            % (names[0], names[1], err, rel, rtol, atol, a, b))
+
+
+def rand_ndarray(shape, ctx=None, dtype=onp.float32, scale=1.0) -> NDArray:
+    return array(onp.random.normal(scale=scale, size=shape).astype(dtype),
+                 ctx=ctx or default_context())
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def simple_forward(fn: Callable, *inputs) -> List[onp.ndarray]:
+    outs = fn(*[array(i) for i in inputs])
+    if isinstance(outs, NDArray):
+        outs = [outs]
+    return [o.asnumpy() for o in outs]
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[onp.ndarray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-4, ctx=None):
+    """Finite-difference check of autograd gradients (reference
+    test_utils.py:860).  ``fn`` maps NDArrays → scalar-reducible NDArray;
+    the check sums the output to a scalar loss.
+    """
+    ctx = ctx or default_context()
+    arrs = [array(x.astype(onp.float64).astype(onp.float32), ctx=ctx) for x in inputs]
+    for a in arrs:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*arrs)
+        loss = out.sum() if out.ndim > 0 else out
+    loss.backward()
+    sym_grads = [a.grad.asnumpy() for a in arrs]
+
+    def eval_loss(np_inputs):
+        with autograd.pause():
+            out = fn(*[array(x, ctx=ctx) for x in np_inputs])
+        return float(out.sum().asscalar() if out.ndim > 0 else out.asscalar())
+
+    for i, x in enumerate(inputs):
+        x = x.astype(onp.float64)
+        num_grad = onp.zeros_like(x)
+        flat = x.reshape(-1)
+        ng = num_grad.reshape(-1)
+        for j in range(flat.size):  # central differences per element
+            orig = flat[j]
+            flat[j] = orig + eps
+            plus = eval_loss([x.reshape(inputs[i].shape).astype(onp.float32) if k == i else inputs[k] for k in range(len(inputs))])
+            flat[j] = orig - eps
+            minus = eval_loss([x.reshape(inputs[i].shape).astype(onp.float32) if k == i else inputs[k] for k in range(len(inputs))])
+            flat[j] = orig
+            ng[j] = (plus - minus) / (2 * eps)
+        assert_almost_equal(sym_grads[i], num_grad.astype(onp.float32),
+                            rtol=rtol, atol=atol,
+                            names=("autograd_grad[%d]" % i, "numeric_grad[%d]" % i))
+
+
+def check_consistency(fn: Callable, inputs: Sequence[onp.ndarray],
+                      ctx_list: Sequence[_context.Context],
+                      rtol: float = 1e-4, atol: float = 1e-5):
+    """Run ``fn`` on each context and cross-check outputs — the reference's
+    backend-equivalence oracle (test_utils.py:1283), repurposed CPU↔TPU."""
+    results = []
+    for ctx in ctx_list:
+        outs = fn(*[array(x, ctx=ctx) for x in inputs])
+        if isinstance(outs, NDArray):
+            outs = [outs]
+        results.append([o.asnumpy() for o in outs])
+    ref = results[0]
+    for ctx, res in zip(ctx_list[1:], results[1:]):
+        for a, b in zip(ref, res):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                                names=("out@%s" % ctx_list[0], "out@%s" % ctx))
+    return results

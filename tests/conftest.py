@@ -1,0 +1,26 @@
+"""Test fixture: run everything on a virtual 8-device CPU mesh.
+
+The analogue of the reference's `tools/launch.py --launcher local`
+multi-process fixture (SURVEY.md §4): multi-device semantics are validated
+on one host by forcing 8 XLA host-platform devices.  Must run before jax
+imports anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    """Seeded reproducibility (reference tests/python/unittest/common.py:117
+    @with_seed)."""
+    import mxnet_tpu as mx
+    mx.random.seed(42)
+    onp.random.seed(42)
+    yield

@@ -1,0 +1,153 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py +
+test_higher_order_grad.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * 2
+    y.backward()
+    assert_almost_equal(x.grad, 4 * onp.array([1, 2, 3], onp.float32))
+
+
+def test_chain_and_multiple_uses():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y * x + y  # x^3 + x^2
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([3 * 4 + 2 * 2], onp.float32))
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad, onp.array([30.0, 60.0]))
+
+
+def test_grad_req_add_and_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([6.0]))
+
+    z = nd.array([1.0])
+    z.attach_grad(grad_req="null")
+    with ag.record():
+        w = z * 5
+    w.backward()
+    assert_almost_equal(z.grad, onp.array([0.0]))  # untouched
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([9.0]))  # only d(9*x)/dx
+    with ag.record():
+        w = nd.BlockGrad(x * x) * x
+    w.backward()
+    assert_almost_equal(x.grad, onp.array([9.0]))
+
+
+def test_recording_scopes():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+    with ag.record(train_mode=False):
+        assert not ag.is_training()
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(g, onp.array([2.0, 4.0]))
+
+
+def test_grad_function():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+    gx = ag.grad(y, x)
+    assert_almost_equal(gx, onp.array([12.0]))
+    # .grad buffer NOT written by ag.grad
+    # reference semantics: grad() returns without touching attached buffers
+
+
+def test_higher_order_grad():
+    x = nd.array([1.5])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x          # y = x^3
+        gx = ag.grad(y, x, create_graph=True, retain_graph=True)  # 3x^2
+        z = gx * gx            # 9 x^4 -> dz/dx = 36 x^3
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([36 * 1.5 ** 3], onp.float32), rtol=1e-4)
+
+
+def test_multi_output_op_grad():
+    x = nd.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    x.attach_grad()
+    with ag.record():
+        mean, var = nd.moments(x, axes=(1,))
+        loss = mean.sum()
+    loss.backward()
+    assert_almost_equal(x.grad, onp.full((2, 3), 1 / 3, onp.float32))
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1 / (1 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.5])
+    x.attach_grad()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-0.5))
+    assert_almost_equal(x.grad, onp.array([s * (1 - s)], onp.float32), rtol=1e-5)
+
+
+def test_backward_inside_multiple_heads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y1 = x * 2
+        y2 = x * 3
+    ag.backward([y1, y2])
+    assert_almost_equal(x.grad, onp.array([5.0, 5.0]))

@@ -1,0 +1,160 @@
+"""NDArray core tests (reference: tests/python/unittest/test_ndarray.py)."""
+import os
+import tempfile
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3) and a.dtype == onp.float32
+    assert same(a, onp.zeros((2, 3)))
+    b = mx.nd.ones((4,), dtype=onp.int32)
+    assert b.dtype == onp.int32
+    c = mx.nd.full((2, 2), 7.0)
+    assert same(c, onp.full((2, 2), 7.0, onp.float32))
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = mx.nd.arange(0, 10, 2)
+    assert same(e, onp.arange(0, 10, 2, dtype=onp.float32))
+    f = mx.nd.eye(3)
+    assert same(f, onp.eye(3, dtype=onp.float32))
+    g = mx.nd.linspace(0, 1, 5)
+    assert_almost_equal(g, onp.linspace(0, 1, 5, dtype=onp.float32))
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert same(a + b, onp.array([[6, 8], [10, 12]], onp.float32))
+    assert same(a - b, -(b - a))
+    assert same(a * 2, onp.array([[2, 4], [6, 8]], onp.float32))
+    assert same(2 * a, a * 2)
+    assert_almost_equal(1.0 / a, onp.array([[1, 0.5], [1 / 3, 0.25]], onp.float32))
+    assert same(a ** 2, a * a)
+    assert same(a // 2, onp.array([[0, 1], [1, 2]], onp.float32))
+    assert same(-a, 0 - a)
+    assert same(abs(-a), a)
+    c = a.copy()
+    c += b
+    assert same(c, a + b)
+    c = a.copy()
+    c *= 3
+    assert same(c, a * 3)
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert same(a == b, onp.array([0, 1, 0], onp.float32))
+    assert same(a > b, onp.array([0, 0, 1], onp.float32))
+    assert same(a <= b, onp.array([1, 1, 0], onp.float32))
+
+
+def test_reshape_special_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((4, 6)).shape == (4, 6)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((0, -2)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((0, -4, 1, 3, 0)).shape == (2, 1, 3, 4)
+    assert a.reshape((-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    b = mx.nd.zeros((8, 3, 3, 3))
+    # reverse=True: infer from the right
+    assert b.reshape((-4, -1, 2, 0, 0, 0), reverse=False).shape == (4, 2, 3, 3, 3)
+
+
+def test_indexing():
+    a = mx.nd.array(onp.arange(24).reshape(2, 3, 4))
+    assert same(a[0], onp.arange(12).reshape(3, 4))
+    assert same(a[1, 2], onp.array([20, 21, 22, 23]))
+    assert same(a[:, 1], onp.arange(24).reshape(2, 3, 4)[:, 1])
+    assert same(a[0, 1:3], onp.arange(24).reshape(2, 3, 4)[0, 1:3])
+    idx = mx.nd.array([1, 0], dtype=onp.int32)
+    assert same(a[idx], onp.arange(24).reshape(2, 3, 4)[[1, 0]])
+    a[0, 0, 0] = 99
+    assert a[0, 0, 0].asscalar() == 99
+    a[1] = 0
+    assert same(a[1], onp.zeros((3, 4)))
+    b = mx.nd.zeros((3,))
+    b[:] = 5
+    assert same(b, onp.full((3,), 5, onp.float32))
+
+
+def test_astype_copy_context():
+    a = mx.nd.array([1.5, 2.5])
+    b = a.astype(onp.int32)
+    assert b.dtype == onp.int32 and same(b, onp.array([1, 2], onp.int32))
+    c = a.copy()
+    c[0] = 9
+    assert a[0].asscalar() == 1.5  # copy is deep
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context == mx.cpu(0)
+    e = mx.nd.zeros((2,))
+    a.copyto(e)
+    assert same(e, a)
+
+
+def test_scalar_conversions():
+    a = mx.nd.array([3.5])
+    assert a.asscalar() == 3.5
+    assert float(a) == 3.5
+    assert int(a) == 3
+    assert bool(a)
+    assert len(mx.nd.zeros((5, 2))) == 5
+    with pytest.raises(ValueError):
+        mx.nd.zeros((2, 2)).asscalar()
+
+
+def test_concat_stack_split():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    d = mx.nd.concat(a, b, dim=1)
+    assert d.shape == (2, 6)
+    e = mx.nd.stack(a, b, axis=0)
+    assert e.shape == (2, 2, 3)
+    parts = mx.nd.split(c, 2, axis=0)
+    assert len(parts) == 2 and same(parts[0], onp.ones((2, 3)))
+    s = mx.nd.add_n(a, a, a)
+    assert same(s, onp.full((2, 3), 3, onp.float32))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.ones((2, 2))
+    mx.nd.save(fname, [a, b])
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list) and same(loaded[0], a) and same(loaded[1], b)
+    mx.nd.save(fname, {"x": a, "y": b})
+    d = mx.nd.load(fname)
+    assert isinstance(d, dict) and same(d["x"], a) and same(d["y"], b)
+
+
+def test_mutation_does_not_corrupt_tape():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * x).sum()
+    x[:] = 100.0  # mutate after record — tape captured values
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([2.0, 4.0]))
+
+
+def test_waitall_and_context():
+    a = mx.nd.ones((4,))
+    a.wait_to_read()
+    mx.nd.waitall()
+    assert mx.cpu(0) == mx.cpu(0)
+    assert mx.cpu(0) != mx.cpu(1)
+    with mx.Context("cpu", 0):
+        b = mx.nd.ones((2,))
+    assert b.context.device_type == "cpu"
