@@ -20,6 +20,9 @@ from . import ndarray
 from . import ndarray as nd
 from . import autograd
 from . import random
+from . import initializer
+from . import initializer as init
+from . import gluon
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray
@@ -27,5 +30,5 @@ from .ndarray import NDArray
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "cpu_pinned",
     "current_context", "num_gpus", "num_tpus", "nd", "ndarray",
-    "autograd", "random", "NDArray",
+    "autograd", "random", "NDArray", "initializer", "init", "gluon",
 ]

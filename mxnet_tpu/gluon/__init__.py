@@ -1,0 +1,13 @@
+"""Gluon: the imperative/hybrid high-level API (reference
+``python/mxnet/gluon/``) rebuilt TPU-native — hybridize compiles to XLA."""
+from . import nn  # noqa: F401
+from . import utils  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import (  # noqa: F401
+    Constant, DeferredInitializationError, Parameter, ParameterDict)
+
+from .utils import split_and_load, split_data  # noqa: F401
+
+__all__ = ["nn", "utils", "Block", "HybridBlock", "SymbolBlock", "Parameter",
+           "Constant", "ParameterDict", "DeferredInitializationError",
+           "split_and_load", "split_data"]

@@ -1,0 +1,123 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``).
+
+``split_and_load`` keeps its multi-device batch-scatter signature; on TPU the
+idiomatic path is a sharded jax.Array over a Mesh (see mxnet_tpu.parallel),
+so this function is the per-device-list compatibility view of that.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as onp
+
+from ..context import Context, cpu
+from ..ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray along ``batch_axis`` into ``num_slice`` pieces
+    (reference gluon/utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data."
+            % (str(data.shape), num_slice, batch_axis, num_slice))
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and place on each context (reference split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the sum of their 2-norms is <= max_norm (reference
+    clip_global_norm). Returns the global norm value."""
+    import jax.numpy as jnp
+
+    def _norm(a):
+        return jnp.sum(jnp.square(a._data))
+
+    total = sum(_norm(a) for a in arrays)
+    total_norm = jnp.sqrt(total)
+    if check_isfinite:
+        v = float(total_norm)
+        if not onp.isfinite(v):
+            import warnings
+            warnings.warn(
+                UserWarning("nan or inf is detected. Clipping results will be "
+                            "undefined."), stacklevel=2)
+    scale = jnp.minimum(1.0, max_norm / (total_norm + 1e-8))
+    for a in arrays:
+        a._data = a._data * scale.astype(a._data.dtype)
+    if check_isfinite:
+        return float(total_norm)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Reference gluon/utils.py download.  This build runs with zero network
+    egress, so only already-present files resolve; otherwise raises."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        "download(%r) requires network access, which is unavailable in this "
+        "environment. Place the file at %r manually." % (url, fname))
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)
+
+
+def _check_same_symbol_type(symbols):
+    return type(symbols[0])
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    if len(lines) == 1:
+        return s_
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)

@@ -1,0 +1,346 @@
+"""Weight initializers.
+
+Reference: ``python/mxnet/initializer.py`` (~800 LoC): registry of named
+initializers applied by name-pattern matching (arrays named ``*_weight`` get
+the default init, ``*_bias``/``*_gamma``... get specialized ones).  TPU-native
+detail: initialization itself runs as jitted XLA code on-device via
+``jax.random`` (stateless keys from ``mxnet_tpu.random``), not host numpy.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from . import random as _random
+from .base import MXNetError
+
+__all__ = [
+    "InitDesc", "Initializer", "register", "Zero", "One", "Constant",
+    "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+    "LSTMBias", "Mixed", "Load", "create",
+]
+
+_INIT_REGISTRY: Dict[str, Type["Initializer"]] = {}
+
+
+def register(klass):
+    """Register an initializer class under its lower-cased name (reference
+    initializer.py ``@register`` / ``mx.init.registry``)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers (reference
+    initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer. Callable on ``(InitDesc, NDArray)`` — fills the
+    array in place (rebind), dispatching on name suffix exactly like the
+    reference (initializer.py ``__call__`` / ``_legacy_init``)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be a string or InitDesc")
+        if isinstance(desc, InitDesc) and desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "") if isinstance(desc, InitDesc) else ""
+        if init:
+            create(init)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- fill helpers (rebind the NDArray's buffer with a jitted fill) ------
+    @staticmethod
+    def _set(arr, value):
+        arr._data = jnp.asarray(value, dtype=arr.dtype).reshape(arr.shape)
+
+    def _init_zero(self, name, arr):
+        self._set(arr, jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_one(self, name, arr):
+        self._set(arr, jnp.ones(arr.shape, arr.dtype))
+
+    def _init_bias(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_gamma(self, name, arr):
+        self._init_one(name, arr)
+
+    def _init_beta(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization "
+            "is now limited to \"weight\", \"bias\", \"gamma\" and \"beta\". "
+            "Please use mx.sym.Variable(init=mx.init.*) to set the pattern." % name)
+
+
+@register
+class Zero(Initializer):
+    """Fill with 0 (reference alias ``zeros``)."""
+    def _init_weight(self, name, arr):
+        self._init_zero(name, arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(name, arr)
+
+
+# reference registers these under both singular and plural names
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        v = self.value
+        if hasattr(v, "asnumpy"):
+            v = v.asnumpy()
+        self._set(arr, jnp.broadcast_to(jnp.asarray(v, dtype=arr.dtype), arr.shape))
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference initializer.py Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        k = _random.next_key()
+        self._set(arr, jax.random.uniform(
+            k, arr.shape, jnp.float32, -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (reference initializer.py Normal)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        k = _random.next_key()
+        self._set(arr, self.sigma * jax.random.normal(k, arr.shape, jnp.float32))
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal basis via QR (reference Orthogonal; Saxe et al. 2013)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        k = _random.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(k, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(k, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, self.scale * q.reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init with uniform/gaussian draw (reference Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                "Xavier initializer cannot be applied to vector %s. It requires"
+                " at least 2D." % name)
+        if len(shape) > 2:
+            hw_scale = onp.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        k = _random.next_key()
+        if self.rnd_type == "uniform":
+            self._set(arr, jax.random.uniform(k, shape, jnp.float32, -scale, scale))
+        elif self.rnd_type == "gaussian":
+            self._set(arr, scale * jax.random.normal(k, shape, jnp.float32))
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming/He init accounting for PReLU slope (reference MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference Bilinear — used by UpSampling
+    deconv weights)."""
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype=onp.float32)
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Zero bias with forget gate set to ``forget_bias`` (reference LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = onp.zeros(arr.shape, dtype=onp.float32)
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+
+class Load:
+    """Init from a dict of arrays, falling back to ``default_init``
+    (reference initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {
+            (k[4:] if k.startswith("arg:") or k.startswith("aux:") else k): v
+            for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError("Parameter %s cannot be initialized from "
+                                 "loading. Shape mismatch, target %s vs loaded %s"
+                                 % (name, arr.shape, src.shape))
+            arr._data = jnp.asarray(src.asnumpy() if hasattr(src, "asnumpy") else src,
+                                    dtype=arr.dtype)
+        else:
+            if self.default_init is None:
+                raise ValueError("Cannot Initialize parameter: %s" % name)
+            self.default_init(name, arr)
+
+
+class Mixed:
+    """Patterns → initializers (reference initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must have the same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError("Parameter name %s did not match any pattern" % name)
+
+
+def create(init, **kwargs):
+    """Create initializer from name / json / instance (reference
+    registry.create used by Parameter(init='xavier'))."""
+    if isinstance(init, Initializer):
+        return init
+    if callable(init):
+        return init
+    if isinstance(init, str):
+        s = init.strip()
+        if s.startswith("["):
+            name, kw = json.loads(s)
+            return _INIT_REGISTRY[name.lower()](**kw)
+        klass = _INIT_REGISTRY.get(s.lower())
+        if klass is None:
+            raise MXNetError("unknown initializer %r" % init)
+        return klass(**kwargs)
+    raise MXNetError("cannot create initializer from %r" % (init,))

@@ -1,0 +1,299 @@
+"""Gluon core tests: Parameter/Block/HybridBlock + layers.
+
+Modelled on the reference's ``tests/python/unittest/test_gluon.py`` strategy:
+construct, initialize, forward eager + hybridized, compare; parameter
+management semantics; deferred shape inference; save/load round-trip.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.name == "weight"
+    assert (p.grad().asnumpy() == 0).all()
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    w = params.get("weight", shape=(10, 10))
+    assert w.name == "net_weight"
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.zero_grad()
+
+
+def test_constant():
+    const_val = onp.ones((2, 3), dtype=onp.float32) * 7
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.const = self.params.get_constant("const", const_val)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    net = Net()
+    net.initialize()
+    x = mx.nd.ones((2, 3))
+    out = net(x)
+    assert (out.asnumpy() == 8).all()
+    # constants take no gradient; grads flow to the input only
+    x.attach_grad()
+    with autograd.record():
+        out = net(x)
+    out.backward()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(8)
+    net.initialize()
+    x = mx.nd.ones((4, 17))
+    y = net(x)
+    assert y.shape == (4, 8)
+    assert net.weight.shape == (8, 17)
+    assert net.bias.shape == (8,)
+
+
+def test_dense_in_units():
+    net = nn.Dense(5, in_units=3, activation="relu")
+    net.initialize()
+    y = net(mx.nd.array(onp.random.randn(2, 3)))
+    assert y.shape == (2, 5)
+    assert (y.asnumpy() >= 0).all()
+
+
+def test_sequential_and_naming():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    y = net(mx.nd.ones((2, 10)))
+    assert y.shape == (2, 4)
+    names = list(net.collect_params().keys())
+    assert len(names) == 4
+    prefix = net.prefix
+    assert all(n.startswith(prefix) for n in names)
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_hybridize_matches_eager():
+    onp.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="tanh"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.randn(5, 7).astype(onp.float32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_jit = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_jit, rtol=1e-5, atol=1e-6)
+    # second call hits the compiled cache
+    y_jit2 = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_jit2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_matches_eager():
+    onp.random.seed(1)
+    def build():
+        net = nn.HybridSequential(prefix="net_")
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+        return net
+
+    netA = build()
+    netA.initialize(mx.init.Constant(0.05))
+    netB = build()
+    netB.initialize(mx.init.Constant(0.05))
+    netB.hybridize()
+
+    x = mx.nd.array(onp.random.randn(4, 6).astype(onp.float32))
+    grads = []
+    for net in (netA, netB):
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        grads.append({k: p.grad().asnumpy()
+                      for k, p in net.collect_params().items()})
+    for k in grads[0]:
+        onp.testing.assert_allclose(grads[0][k], grads[1][k],
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(onp.random.randn(8, 4, 3, 3).astype(onp.float32) * 3 + 2)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    rv = bn.running_var.data().asnumpy()
+    assert not (rm == 0).all(), "running mean should move after a training fwd"
+    assert not (rv == 1).all()
+    # eval mode uses running stats; must not change them
+    y = bn(x)
+    onp.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm)
+    assert y.shape == x.shape
+
+
+def test_batchnorm_running_stats_update_hybridized():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    bn.hybridize()
+    x = mx.nd.array(onp.random.randn(8, 4, 3, 3).astype(onp.float32) * 3 + 2)
+    with autograd.record():
+        bn(x)  # warm-up (eager — completes deferred)
+    with autograd.record():
+        bn(x)  # compiled path
+    rm = bn.running_mean.data().asnumpy()
+    assert not (rm == 0).all(), "hybridized BN must still update aux state"
+
+
+def test_conv2d():
+    net = nn.Conv2D(8, kernel_size=3, padding=1, activation="relu")
+    net.initialize()
+    x = mx.nd.ones((2, 3, 8, 8))
+    y = net(x)
+    assert y.shape == (2, 8, 8, 8)
+    assert net.weight.shape == (8, 3, 3, 3)
+
+
+def test_conv_transpose():
+    net = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    net.initialize()
+    x = mx.nd.ones((1, 3, 5, 5))
+    y = net(x)
+    assert y.shape == (1, 4, 10, 10)
+
+
+def test_pooling_layers():
+    x = mx.nd.array(onp.random.randn(2, 3, 8, 8).astype(onp.float32))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_dropout_train_vs_eval():
+    do = nn.Dropout(0.5)
+    x = mx.nd.ones((100, 100))
+    y_eval = do(x)
+    onp.testing.assert_allclose(y_eval.asnumpy(), x.asnumpy())
+    with autograd.record():
+        y_train = do(x)
+    arr = y_train.asnumpy()
+    assert (arr == 0).any(), "dropout should zero some entries in train mode"
+    assert abs(arr.mean() - 1.0) < 0.1, "inverted dropout keeps the mean"
+
+
+def test_embedding_flatten():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([[1, 2], [3, 4]])
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+    fl = nn.Flatten()
+    assert fl(out).shape == (2, 8)
+
+
+def test_layernorm_groupnorm():
+    x = mx.nd.array(onp.random.randn(2, 6, 4).astype(onp.float32))
+    ln = nn.LayerNorm()
+    ln.initialize()
+    y = ln(x).asnumpy()
+    onp.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    gn = nn.GroupNorm(num_groups=3)
+    gn.initialize()
+    assert gn(x).shape == x.shape
+
+
+def test_activations_layers():
+    x = mx.nd.array(onp.random.randn(3, 4).astype(onp.float32))
+    for layer in [nn.LeakyReLU(0.1), nn.ELU(), nn.SELU(), nn.GELU(), nn.Swish()]:
+        assert layer(x).shape == x.shape
+    pr = nn.PReLU()
+    pr.initialize()
+    assert pr(x).shape == x.shape
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.ones((1, 4))
+    y0 = net(x).asnumpy()
+    f = str(tmp_path / "model.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential(prefix="model2_")
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    y1 = net2(x).asnumpy()
+    onp.testing.assert_allclose(y0, y1, rtol=1e-6)
+
+
+def test_custom_hybrid_block():
+    class MLP(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.fc1 = nn.Dense(16)
+                self.fc2 = nn.Dense(2)
+
+        def hybrid_forward(self, F, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = MLP()
+    net.initialize()
+    y = net(mx.nd.ones((3, 5)))
+    assert y.shape == (3, 2)
+    net.hybridize()
+    y2 = net(mx.nd.ones((3, 5)))
+    onp.testing.assert_allclose(y.asnumpy(), y2.asnumpy(), rtol=1e-5)
+
+
+def test_lambda_blocks():
+    lam = nn.Lambda("relu")
+    x = mx.nd.array([[-1.0, 2.0]])
+    assert (lam(x).asnumpy() == [[0.0, 2.0]]).all()
+    hl = nn.HybridLambda(lambda F, a: a * 2)
+    assert (hl(x).asnumpy() == [[-2.0, 4.0]]).all()
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="sel_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4), nn.Dense(4, in_units=4))
+    weights = net.collect_params(".*weight")
+    assert len(weights) == 2
+    assert all(k.endswith("weight") for k in weights.keys())
+
+
+def test_grad_req_null():
+    net = nn.Dense(3, in_units=3)
+    net.initialize()
+    net.collect_params().setattr("grad_req", "null")
+    x = mx.nd.ones((2, 3))
+    with autograd.record():
+        y = net(x)
+    # no grads attached → backward on params not possible, but forward fine
+    assert y.shape == (2, 3)
