@@ -22,7 +22,14 @@ from . import autograd
 from . import random
 from . import initializer
 from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
 from . import gluon
+from . import parallel
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray
@@ -31,4 +38,6 @@ __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "cpu_pinned",
     "current_context", "num_gpus", "num_tpus", "nd", "ndarray",
     "autograd", "random", "NDArray", "initializer", "init", "gluon",
+    "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
+    "parallel",
 ]

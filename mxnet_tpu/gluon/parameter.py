@@ -254,6 +254,12 @@ class Parameter:
             self._init_impl(initializer.Constant(data),
                             ctx or current_context(), None)
 
+    def _set_trainer(self, trainer):
+        """Associate with a Trainer (reference parameter.py _set_trainer;
+        sparse row_sparse params require exactly one trainer there — dense
+        arrays have no such restriction, so we only keep the link)."""
+        self._trainer = trainer
+
     def var(self):
         """Symbol view of this parameter (for Symbol/Module interop)."""
         from .. import symbol

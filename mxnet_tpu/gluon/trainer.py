@@ -1,0 +1,248 @@
+"""Gluon Trainer: applies an Optimizer to a set of Parameters.
+
+Reference: ``python/mxnet/gluon/trainer.py`` (495 LoC) — ``step`` (:305) =
+``_allreduce_grads`` (kvstore push/pull :356-365) + ``_update`` (:399);
+kvstore selection logic ``_init_kvstore`` (:169).
+
+TPU-native behavior: with one logical (possibly mesh-sharded) array per
+parameter, gradient all-reduce is either implicit (global-view jit) or an
+ICI psum via ``KVStoreTPU`` — the kvstore round-trip shrinks to at most one
+collective per parameter, and the optimizer update runs as a pure fused XLA
+op per parameter (``optimizer.py _apply``).
+"""
+from __future__ import annotations
+
+from .. import kvstore as kvs
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """Optimizer driver (reference trainer.py:45).
+
+    Parameters
+    ----------
+    params : ParameterDict | dict | list of Parameter
+    optimizer : str or Optimizer
+    optimizer_params : dict
+    kvstore : str or KVStore or None — 'device' (default), 'local', 'tpu',
+        'dist_sync' … (reference kvstore arg)
+    update_on_kvstore : bool, default None — kept for API parity; updates
+        always run through the store's updater (the reference's
+        update_on_kvstore=True semantics, which its dist path requires too).
+    """
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict, ParameterDict)):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._set_trainer(self)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = opt.get_updater(self._optimizer)
+
+    def _reset_kvstore(self):
+        if self._kvstore and "dist" in self._kvstore.type:
+            raise RuntimeError("Cannot reset distributed KVStore.")
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [param for param in self._params]
+
+    def _init_kvstore(self):
+        """(reference trainer.py:169) Pick and set up the kvstore."""
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            if isinstance(kvstore, str):
+                kvstore = kvs.create(kvstore)
+            self._kvstore = kvstore
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            self._update_on_kvstore = True if update_on_kvstore is None \
+                else update_on_kvstore
+            if self._update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    def _init_params(self):
+        assert self._kv_initialized, \
+            "Cannot initialize parameters in KVStore when KVStore is not " \
+            "initialized."
+        params_to_init = []
+        if self._kvstore:
+            for param in self._params_to_init:
+                if param._deferred_init:
+                    params_to_init.append(param)
+                else:
+                    idx = self._param2idx[param.name]
+                    self._kvstore.init(idx, param.data())
+        self._params_to_init = params_to_init
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning "
+                              "rate can be accessed.")
+        return self._optimizer.learning_rate if hasattr(
+            self._optimizer, "learning_rate") else self._optimizer.lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning "
+                              "rate is mutated.")
+        self._optimizer.lr = lr
+
+    def allreduce_grads(self):
+        """Explicit grad all-reduce, for when update is done manually
+        (reference trainer.py:336)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "allreduce_grads() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` " \
+            "to False when creating trainer."
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore and not self._update_on_kvstore:
+            from .. import parallel
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    g = parallel.allreduce(param.grad())
+                    g.copyto(param.grad())
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """One optimization step over recorded gradients (reference
+        trainer.py:305)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._kvstore and self._update_on_kvstore:
+            # push grads, pull updated weights (reference _update_params_on_kvstore)
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
+                    continue
+                if not ignore_stale_grad:
+                    self._check_fresh(param)
+                self._kvstore.push(i, param.grad())
+                self._kvstore.pull(i, out=param.data())
+            return
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._kvstore and self._kv_initialized:
+            if self._optimizer.rescale_grad != scale:
+                raise UserWarning(
+                    "Possible change in the `batch_size` from previous "
+                    "`step` detected. Optimizer gradient normalizing "
+                    "factor will not change w.r.t new batch_size when "
+                    "update_on_kvstore=True")
+        self._optimizer.rescale_grad = scale
+
+    def _check_fresh(self, param):
+        pass  # freshness tracking is a no-op: grads are written by backward()
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Manual update step (reference trainer.py:378) — requires
+        allreduce_grads() to have been called when using a kvstore."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` " \
+            "to False when creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            self._updaters(i, param.grad(), param.data())
+
+    def save_states(self, fname):
+        """(reference trainer.py:440)"""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore and self._kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters.get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """(reference trainer.py:463)"""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore and self._kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            self._updaters.set_states(states)
+            self._optimizer = self._updaters.optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}

@@ -1,0 +1,235 @@
+"""KVStore: key-value store for parameter synchronisation.
+
+Reference: ``include/mxnet/kvstore.h:59-411`` + ``python/mxnet/kvstore.py`` —
+``KVStore.create("local"/"device"/"nccl"/"dist_sync"/"dist_async")`` with
+Init/Push/Pull/Barrier/set_optimizer/set_updater; the C++ side reduces
+gradients across GPUs (comm.h) or over a ps-lite parameter server
+(kvstore_dist.h).
+
+TPU-native redesign (SURVEY.md §2.3 / §7): synchronous SPMD training over an
+ICI/DCN mesh makes push/pull collapse into collectives *inside the jitted
+train step* — there is no separate communication runtime to schedule.  This
+module therefore provides:
+
+* ``KVStoreLocal`` — single-process store with updater semantics, backing
+  ``kvstore('local' | 'device')``.  On one chip push/pull is a dict access;
+  with a mesh, pushed gradients are already jax global arrays whose
+  reduction XLA performs via psum when the Trainer's step is jitted.
+* ``KVStoreTPU`` — ``kvstore('tpu' | 'nccl' | 'dist_sync' | 'dist_device_sync')``:
+  the same API, but ``push`` all-reduces over the mesh's data-parallel axis
+  (``mxnet_tpu.parallel``).  rank/num_workers map to
+  ``jax.process_index/process_count``.
+* gradient-compression API accepted for parity (2-bit compression is not
+  needed on ICI; stored and surfaced via ``gradient_compression`` attr).
+
+``dist_async`` has no SPMD analogue and raises (SURVEY.md §7 hard-parts).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Callable, Dict, List, Optional
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """Base KVStore interface (reference kvstore.h:59, python kvstore.py)."""
+
+    def __init__(self):
+        self._updater: Optional[Callable] = None
+        self._compression_params = None
+
+    # -- interface -----------------------------------------------------
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense emulation: sparse storage is out of scope on TPU (SURVEY §2.2)
+        return self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    # -- configuration -------------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        """Accepted for API parity (reference kvstore.py:394).  ICI
+        collectives are not bandwidth-bound at MXNet's model scale, so
+        compression is recorded but not applied."""
+        self._compression_params = compression_params
+
+    def set_optimizer(self, optimizer):
+        """Install an optimizer as the updater (reference kvstore.py:450 —
+        which pickles the optimizer to remote servers; here the 'server' is
+        this process)."""
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    # -- roles (reference kvstore.py:513-526) --------------------------
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    @property
+    def type(self) -> str:
+        return self._type
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+class KVStoreLocal(KVStore):
+    """Single-process store (reference src/kvstore/kvstore_local.h:184-235:
+    push groups keys → reduce → updater → pull broadcasts).
+
+    With one logical jax.Array per key there is nothing to reduce across —
+    multi-device arrays are reduced by XLA inside the jitted step — so push
+    stores (or updates), pull copies out.
+    """
+
+    def __init__(self, type_str="local"):
+        super().__init__()
+        self._type = type_str
+        self._store: Dict = {}
+
+    def init(self, key, value):
+        keys = _as_list(key)
+        values = _as_list(value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        keys = _as_list(key)
+        values = _as_list(value)
+        if len(keys) == 1 and len(values) > 1:
+            # push(key, [per-device grads]) → one aggregated value
+            values = [value]
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                # per-device gradient list (reference: Comm Reduce) — sum
+                merged = v[0]
+                for o in v[1:]:
+                    merged = merged + o
+                v = merged
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % str(k))
+            if self._updater is not None:
+                idx = int(k) if isinstance(k, str) and k.isdigit() else k
+                self._updater(idx, v, self._store[k])
+            else:
+                self._store[k] = v if not isinstance(v, NDArray) else v.copy()
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = _as_list(key)
+        outs = _as_list(out)
+        flat = []
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            for dst in _as_list(o):
+                src.copyto(dst)
+            flat.append(o)
+        return out
+
+
+class KVStoreTPU(KVStoreLocal):
+    """Mesh-synchronous store: push() all-reduces gradients across the
+    data-parallel axis (reference NCCL/dist_sync path,
+    ``src/kvstore/kvstore_nccl.h`` / ``kvstore_dist.h``; here psum over ICI).
+
+    Outside jit this performs an eager all-reduce via
+    ``parallel.allreduce_``; inside a jitted train step the same call traces
+    to ``lax.psum`` so communication fuses with compute — the reference
+    overlaps comm/compute via engine priorities (model.py:146), XLA does the
+    same scheduling automatically.
+    """
+
+    def __init__(self, type_str="tpu"):
+        super().__init__(type_str)
+
+    def push(self, key, value, priority=0):
+        from . import parallel
+        keys = _as_list(key)
+        values = _as_list(value)
+        reduced = []
+        for v in values:
+            if isinstance(v, (list, tuple)):
+                merged = v[0]
+                for o in v[1:]:
+                    merged = merged + o
+                v = merged
+            reduced.append(parallel.allreduce(v))
+        super().push(keys, reduced, priority)
+
+    @property
+    def rank(self) -> int:
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        import jax
+        return jax.process_count()
+
+    def barrier(self):
+        from .ndarray import waitall
+        waitall()
+
+
+def create(name="local") -> KVStore:
+    """Create a KVStore (reference python/mxnet/kvstore.py create /
+    KVStore::Create kvstore.cc).
+
+    'local'/'device' → KVStoreLocal (single logical array; intra-chip).
+    'tpu'/'nccl'/'dist_sync'/'dist_device_sync'/'horovod' → KVStoreTPU
+    (mesh all-reduce).  'dist_async' is unsupported (no SPMD analogue —
+    SURVEY.md §7).
+    """
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name_l = name.lower()
+    if name_l in ("local", "local_allreduce_cpu", "local_allreduce_device", "device"):
+        return KVStoreLocal(name_l)
+    if name_l in ("tpu", "nccl", "dist_sync", "dist_device_sync", "dist", "horovod"):
+        return KVStoreTPU(name_l)
+    if name_l == "dist_async":
+        raise MXNetError(
+            "dist_async has no synchronous-SPMD analogue on TPU; use "
+            "'dist_sync' (see SURVEY.md §7 hard-parts)")
+    raise MXNetError("unknown KVStore type %s" % name)

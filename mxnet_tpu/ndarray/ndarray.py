@@ -331,6 +331,18 @@ class NDArray:
 
     __hash__ = object.__hash__  # identity hash, like the reference
 
+    # pickling (used by optimizer-state save/load and DataLoader workers;
+    # reference serializes via NDArray::Save)
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx_kind": self._ctx.device_type}
+
+    def __setstate__(self, state):
+        from ..context import Context
+        ctx = Context(state["ctx_kind"])
+        self._ctx = ctx
+        self._data = jax.device_put(jnp.asarray(state["data"]), ctx.jax_device)
+        self._ag = None
+
     # ------------------------------------------------------------------
     # registry-backed methods: a.relu(), a.sum(axis=1), a.transpose() …
     # mirrors the reference's codegen of NDArray methods from the op

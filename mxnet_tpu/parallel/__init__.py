@@ -1,0 +1,90 @@
+"""Parallelism: device meshes, collectives, SPMD train steps, ring attention.
+
+TPU-native replacement for the reference's entire distributed stack
+(SURVEY.md §2.3): KVStore comm trees (``src/kvstore/comm.h``,
+``comm_tree.h``), NCCL (``kvstore_nccl.h``), and the ps-lite parameter
+server (``kvstore_dist.h``) all collapse into **XLA collectives over an ICI
+mesh** expressed with ``jax.sharding`` + ``shard_map``:
+
+* reduce/broadcast of gradients  → ``lax.psum`` (inserted by GSPMD or
+  explicit in shard_map)
+* parameter-server key sharding  → parameter/optimizer-state sharding
+  annotations (ZeRO-style), no RPC
+* the scheduler/role bootstrap   → ``jax.distributed.initialize``
+* topology-aware reduce trees (gpu_topology.h Kernighan-Lin) → not needed:
+  XLA routes collectives on the ICI torus.
+
+Axis convention: ``dp`` (data), ``tp`` (tensor/model), ``pp`` (pipeline),
+``sp`` (sequence/context).  The reference only has dp (+ device placement);
+tp/pp/sp are capabilities the TPU build adds (SURVEY.md §2.3 rows TP/PP/SP).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import (  # noqa: F401
+    current_mesh, default_mesh, device_mesh, get_mesh, set_mesh,
+)
+from .collectives import (  # noqa: F401
+    allreduce, all_gather, pmean, ppermute, psum, reduce_scatter,
+)
+from .data_parallel import DataParallelStep  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    blockwise_attention, ring_attention, ring_attention_sharded)
+
+__all__ = [
+    "Mesh", "NamedSharding", "P",
+    "current_mesh", "default_mesh", "device_mesh", "get_mesh", "set_mesh",
+    "allreduce", "all_gather", "pmean", "ppermute", "psum", "reduce_scatter",
+    "DataParallelStep", "ring_attention", "ring_attention_sharded",
+    "blockwise_attention", "shard_batch", "replicate", "initialize",
+]
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Multi-host bootstrap (reference: ps-lite scheduler roles via
+    DMLC_PS_ROOT_URI etc., docs/faq/distributed_training.md:254; here the
+    jax coordination service)."""
+    kw = {}
+    if coordinator_address is not None:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+
+
+def shard_batch(x, mesh: Optional[Mesh] = None, axis: str = "dp"):
+    """Place a host batch onto the mesh, sharded along its leading dim —
+    the analogue of `DataParallelExecutorGroup.decide_slices` + `_load_data`
+    scatter (reference executor_group.py:282-304,451), done by sharding
+    annotation instead of explicit per-GPU copies."""
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    val = x._data if isinstance(x, NDArray) else x
+    spec = P(axis, *([None] * (val.ndim - 1)))
+    out = jax.device_put(val, NamedSharding(mesh, spec))
+    return _wrap(out, x.context) if isinstance(x, NDArray) else out
+
+
+def replicate(x, mesh: Optional[Mesh] = None):
+    """Replicate a value across the mesh (parameter broadcast — the
+    reference's kvstore Broadcast / comm.h broadcast path)."""
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    val = x._data if isinstance(x, NDArray) else x
+    out = jax.device_put(val, NamedSharding(mesh, P()))
+    return _wrap(out, x.context) if isinstance(x, NDArray) else out

@@ -1,0 +1,92 @@
+"""Collectives: the communication vocabulary.
+
+Replaces the reference's comm implementations (``src/kvstore/comm.h``
+CommCPU/CommDevice reduce+broadcast, ``comm_tree.h`` tree allreduce,
+``kvstore_nccl.h`` NCCL) with XLA collectives.  Two call modes:
+
+* **inside shard_map/pmap trace**: thin wrappers over ``jax.lax`` psum /
+  all_gather / ppermute — collectives ride ICI, overlap scheduled by XLA.
+* **eager, global-view arrays**: JAX arrays are *global*; a sum over the
+  batch axis of a dp-sharded array already is the all-reduced value, so the
+  eager ``allreduce`` re-replicates the (already-global) value instead of
+  communicating — semantic parity with kvstore push/pull without a second
+  comm path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
+           "allreduce"]
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap(x):
+    from ..ndarray import NDArray
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _rewrap(val, like):
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap
+    if isinstance(like, NDArray):
+        return _wrap(val, like.context)
+    return val
+
+
+def psum(x, axis_name: str = "dp"):
+    """All-reduce-sum across a named mesh axis (use under shard_map/pmap).
+    The reference's KVStore push+pull sum (kvstore_local.h:184) in one op."""
+    val = _unwrap(x)
+    return _rewrap(lax.psum(val, axis_name), x)
+
+
+def pmean(x, axis_name: str = "dp"):
+    val = _unwrap(x)
+    return _rewrap(lax.pmean(val, axis_name), x)
+
+
+def all_gather(x, axis_name: str = "dp", axis: int = 0, tiled: bool = True):
+    val = _unwrap(x)
+    return _rewrap(lax.all_gather(val, axis_name, axis=axis, tiled=tiled), x)
+
+
+def reduce_scatter(x, axis_name: str = "dp", scatter_dimension: int = 0):
+    val = _unwrap(x)
+    return _rewrap(
+        lax.psum_scatter(val, axis_name, scatter_dimension=scatter_dimension,
+                         tiled=True), x)
+
+
+def ppermute(x, perm, axis_name: str = "dp"):
+    """Neighbour exchange on the ICI ring — the building block of ring
+    attention and pipeline parallelism."""
+    val = _unwrap(x)
+    return _rewrap(lax.ppermute(val, axis_name, perm), x)
+
+
+def allreduce(x, axis_name: str = "dp"):
+    """Gradient all-reduce with call-mode dispatch (see module docstring).
+
+    Inside a shard_map/pmap trace → real ``lax.psum``.  Eagerly on global
+    arrays → identity-with-replication: the global value already includes
+    every shard's contribution (global-view semantics), matching what the
+    reference's push+pull round-trip produces.
+    """
+    val = _unwrap(x)
+    if _is_traced(val):
+        try:
+            return _rewrap(lax.psum(val, axis_name), x)
+        except NameError:
+            return x  # traced under plain jit (no named axis): global value
+    from .mesh import get_mesh
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return _rewrap(jax.device_put(val, NamedSharding(mesh, P())), x)

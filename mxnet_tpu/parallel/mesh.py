@@ -1,0 +1,79 @@
+"""Device-mesh management.
+
+The mesh is the TPU analogue of the reference's device list
+(``Module(context=[gpu(0)..gpu(N)])``) plus its comm topology
+(``src/kvstore/gpu_topology.h`` link-matrix spanning trees) — except the
+topology work is XLA's job; we only name axes and pick shapes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh
+
+__all__ = ["set_mesh", "get_mesh", "current_mesh", "default_mesh", "device_mesh"]
+
+
+class _MeshState(threading.local):
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+
+
+_STATE = _MeshState()
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    """Install the process-wide mesh used by kvstore('tpu'), Trainer and
+    shard_batch."""
+    _STATE.mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _STATE.mesh
+
+
+class current_mesh:
+    """Context manager scoping a mesh."""
+
+    def __init__(self, mesh: Mesh):
+        self._mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _STATE.mesh
+        _STATE.mesh = self._mesh
+        return self._mesh
+
+    def __exit__(self, *a):
+        _STATE.mesh = self._prev
+        return False
+
+
+def device_mesh(shape: Optional[Sequence[int]] = None,
+                axis_names: Sequence[str] = ("dp",),
+                devices=None) -> Mesh:
+    """Build a named mesh over devices.
+
+    ``device_mesh()`` → 1-D data-parallel mesh over all local devices;
+    ``device_mesh((4, 2), ("dp", "tp"))`` → 2-D dp×tp mesh.  On real slices
+    jax orders devices along ICI rings so neighbouring mesh coordinates are
+    physical neighbours (what gpu_topology.h's Kernighan-Lin clustering
+    approximated for PCIe).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    arr = onp.array(devices).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def default_mesh() -> Mesh:
+    """The installed mesh, or a fresh all-device dp mesh."""
+    m = get_mesh()
+    if m is None:
+        m = device_mesh()
+        set_mesh(m)
+    return m

@@ -7,10 +7,18 @@ imports anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (the session env pre-sets JAX_PLATFORMS=axon for the real chip,
+# and the axon plugin's register() additionally does
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter start —
+# tests must never compile over the tunnel, so override both)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as onp  # noqa: E402
 import pytest  # noqa: E402

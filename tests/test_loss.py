@@ -1,0 +1,152 @@
+"""Loss tests vs numpy references (reference test_loss.py strategy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import loss as gloss
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_l2_loss():
+    pred = onp.random.randn(4, 3).astype("float32")
+    label = onp.random.randn(4, 3).astype("float32")
+    L = gloss.L2Loss()
+    out = _np(L(mx.nd.array(pred), mx.nd.array(label)))
+    ref = 0.5 * ((pred - label) ** 2).mean(axis=1)
+    onp.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_l1_loss():
+    pred = onp.random.randn(4, 3).astype("float32")
+    label = onp.random.randn(4, 3).astype("float32")
+    out = _np(gloss.L1Loss()(mx.nd.array(pred), mx.nd.array(label)))
+    onp.testing.assert_allclose(out, onp.abs(pred - label).mean(axis=1), rtol=1e-5)
+
+
+def test_softmax_ce_sparse_and_dense():
+    logits = onp.random.randn(6, 5).astype("float32")
+    labels = onp.random.randint(0, 5, 6)
+    ls = gloss.SoftmaxCrossEntropyLoss()
+    out = _np(ls(mx.nd.array(logits), mx.nd.array(labels.astype("float32"))))
+    p = onp.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    ref = -onp.log(p[onp.arange(6), labels])
+    onp.testing.assert_allclose(out, ref, rtol=1e-4)
+    onehot = onp.eye(5, dtype="float32")[labels]
+    ld = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)
+    out2 = _np(ld(mx.nd.array(logits), mx.nd.array(onehot)))
+    onp.testing.assert_allclose(out2, ref, rtol=1e-4)
+
+
+def test_sigmoid_bce():
+    pred = onp.random.randn(4, 3).astype("float32")
+    label = onp.random.randint(0, 2, (4, 3)).astype("float32")
+    out = _np(gloss.SigmoidBCELoss()(mx.nd.array(pred), mx.nd.array(label)))
+    x, z = pred, label
+    ref = (onp.maximum(x, 0) - x * z + onp.log1p(onp.exp(-onp.abs(x)))).mean(1)
+    onp.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_kl_div():
+    logp = onp.log(onp.random.dirichlet(onp.ones(5), 4).astype("float32"))
+    q = onp.random.dirichlet(onp.ones(5), 4).astype("float32")
+    out = _np(gloss.KLDivLoss()(mx.nd.array(logp), mx.nd.array(q)))
+    ref = (q * (onp.log(q + 1e-12) - logp)).mean(1)
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_huber_hinge_logistic():
+    pred = onp.random.randn(8).astype("float32")
+    label = onp.sign(onp.random.randn(8)).astype("float32")
+    h = _np(gloss.HuberLoss()(mx.nd.array(pred), mx.nd.array(label)))
+    assert h.shape == (8,)
+    hg = _np(gloss.HingeLoss()(mx.nd.array(pred), mx.nd.array(label)))
+    onp.testing.assert_allclose(hg, onp.maximum(1 - pred * label, 0), rtol=1e-5)
+    lg = _np(gloss.LogisticLoss()(mx.nd.array(pred), mx.nd.array(label)))
+    ref = onp.log1p(onp.exp(-pred * label))
+    onp.testing.assert_allclose(lg, ref, rtol=1e-4)
+
+
+def test_triplet_cosine_poisson():
+    a = onp.random.randn(4, 6).astype("float32")
+    p = onp.random.randn(4, 6).astype("float32")
+    n = onp.random.randn(4, 6).astype("float32")
+    t = _np(gloss.TripletLoss()(mx.nd.array(a), mx.nd.array(p), mx.nd.array(n)))
+    ref = onp.maximum(
+        ((a - p) ** 2 - (a - n) ** 2).sum(1) + 1, 0)
+    onp.testing.assert_allclose(t, ref, rtol=1e-4)
+
+    lbl = onp.array([1, -1, 1, -1], "float32")
+    c = _np(gloss.CosineEmbeddingLoss()(
+        mx.nd.array(a), mx.nd.array(p), mx.nd.array(lbl)))
+    assert c.shape == (4,)
+
+    rate = onp.random.rand(4, 3).astype("float32") + 0.1
+    tgt = onp.random.poisson(2, (4, 3)).astype("float32")
+    pl = _np(gloss.PoissonNLLLoss(from_logits=False)(
+        mx.nd.array(rate), mx.nd.array(tgt)))
+    ref = (rate - tgt * onp.log(rate + 1e-8)).mean()
+    onp.testing.assert_allclose(pl, ref, rtol=1e-4)
+
+
+def test_ctc_loss_simple():
+    """CTC vs brute-force enumeration on a tiny case."""
+    T, N, C, L = 4, 1, 3, 2
+    onp.random.seed(3)
+    logits = onp.random.randn(N, T, C).astype("float32")
+    label = onp.array([[1, 2]], "float32")
+    out = _np(gloss.CTCLoss()(mx.nd.array(logits), mx.nd.array(label)))
+
+    # brute force: sum over all paths collapsing to [1, 2]
+    p = onp.exp(logits[0] - logits[0].max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+
+    def collapse(path):
+        out_seq = []
+        prev = None
+        for s in path:
+            if s != prev and s != 0:
+                out_seq.append(s)
+            prev = s
+        return out_seq
+
+    total = 0.0
+    import itertools
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == [1, 2]:
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    ref = -onp.log(total)
+    onp.testing.assert_allclose(out[0], ref, rtol=1e-3)
+
+
+def test_loss_backward():
+    pred = mx.nd.array(onp.random.randn(4, 3).astype("float32"))
+    label = mx.nd.array(onp.random.randint(0, 3, 4).astype("float32"))
+    pred.attach_grad()
+    L = gloss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        l = L(pred, label).mean()
+    l.backward()
+    g = pred.grad.asnumpy()
+    assert onp.abs(g).sum() > 0
+    # gradient of mean CE wrt logits = (softmax - onehot)/N
+    p = onp.exp(pred.asnumpy() - pred.asnumpy().max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    onehot = onp.eye(3, dtype="float32")[label.asnumpy().astype(int)]
+    onp.testing.assert_allclose(g, (p - onehot) / 4, rtol=1e-4, atol=1e-6)
+
+
+def test_sample_weight():
+    pred = onp.random.randn(4, 3).astype("float32")
+    label = onp.random.randn(4, 3).astype("float32")
+    sw = onp.array([[1.0], [0.0], [1.0], [0.0]], "float32")
+    out = _np(gloss.L2Loss()(mx.nd.array(pred), mx.nd.array(label),
+                             mx.nd.array(sw)))
+    assert out[1] == 0.0 and out[3] == 0.0 and out[0] > 0

@@ -1,0 +1,148 @@
+"""Optimizer tests: each rule vs a hand-rolled numpy reference step.
+
+Mirrors the reference's tests/python/unittest/test_optimizer.py strategy
+(compare C++ update kernels against PythonSGD etc.).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run_steps(optimizer, w0, g_fn, n=4):
+    w = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for t in range(n):
+        g = mx.nd.array(g_fn(t))
+        optimizer.update(0, w, g, state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    onp.random.seed(0)
+    w0 = onp.random.randn(5, 4).astype("float32")
+    grads = [onp.random.randn(5, 4).astype("float32") for _ in range(4)]
+    got = _run_steps(opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01),
+                     w0, lambda t: grads[t])
+    w = w0.copy()
+    mom = onp.zeros_like(w)
+    for g in grads:
+        gg = g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * gg
+        w = w + mom
+    onp.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum():
+    w0 = onp.ones((3,), "float32")
+    g = onp.ones((3,), "float32")
+    got = _run_steps(opt.SGD(learning_rate=0.5), w0, lambda t: g, n=2)
+    onp.testing.assert_allclose(got, onp.ones(3) - 2 * 0.5, rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    onp.random.seed(1)
+    w0 = onp.random.randn(6).astype("float32")
+    grads = [onp.random.randn(6).astype("float32") for _ in range(5)]
+    got = _run_steps(opt.Adam(learning_rate=0.01), w0, lambda t: grads[t], n=5)
+    w = w0.copy()
+    m = onp.zeros_like(w)
+    v = onp.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr_t = 0.01 * onp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr_t * m / (onp.sqrt(v) + eps)
+    onp.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_rmsprop_runs_and_descends():
+    w0 = onp.full((4,), 5.0, "float32")
+    o = opt.RMSProp(learning_rate=0.1)
+    got = _run_steps(o, w0, lambda t: w0 * 0 + 1.0, n=10)
+    assert (got < w0).all()
+
+
+def test_clip_gradient():
+    w0 = onp.zeros((3,), "float32")
+    o = opt.SGD(learning_rate=1.0, clip_gradient=0.5)
+    got = _run_steps(o, w0, lambda t: onp.full((3,), 10.0, "float32"), n=1)
+    onp.testing.assert_allclose(got, onp.full((3,), -0.5), rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", [
+    "sgd", "nag", "adam", "adagrad", "rmsprop", "adadelta", "ftrl",
+    "adamax", "nadam", "signum", "ftml", "dcasgd", "sgld", "lbsgd"])
+def test_all_optimizers_step(name):
+    """Every registered rule takes a step without error and changes w."""
+    kwargs = {"lbsgd": {"momentum": 0.9}}.get(name, {})
+    o = opt.create(name, learning_rate=0.01, **kwargs)
+    w0 = onp.random.RandomState(2).randn(4, 3).astype("float32")
+    got = _run_steps(o, w0, lambda t: onp.ones((4, 3), "float32"), n=2)
+    assert got.shape == w0.shape
+    assert not onp.allclose(got, w0)
+
+
+def test_lr_mult_wd_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    o.set_lr_mult({"fc_weight": 0.0})
+    w = mx.nd.ones((2,))
+    g = mx.nd.ones((2,))
+    o.update(0, w, g, o.create_state(0, w))
+    onp.testing.assert_allclose(w.asnumpy(), onp.ones(2))  # lr_mult=0 → frozen
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam(learning_rate=0.01)
+    u = opt.get_updater(o)
+    w = mx.nd.array(onp.random.randn(3).astype("float32"))
+    g = mx.nd.array(onp.random.randn(3).astype("float32"))
+    u(0, g, w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.Adam(learning_rate=0.01))
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_multi_precision_fp16():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = mx.nd.array(onp.random.randn(4).astype("float16"))
+    g = mx.nd.array(onp.random.randn(4).astype("float16"))
+    state = o.create_state_multi_precision(0, w)
+    master, _ = state
+    assert master.dtype == onp.float32
+    o.update_multi_precision(0, w, g, state)
+    assert w.dtype == onp.float16
+
+
+def test_lr_scheduler_integration():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.ones((1,))
+    g = mx.nd.zeros((1,))
+    st = o.create_state(0, w)
+    lrs = []
+    for _ in range(6):
+        o.update(0, w, g, st)
+        lrs.append(o._get_lr(0))
+    assert lrs[0] == 1.0 and lrs[-1] < 1.0
+
+
+def test_schedulers():
+    from mxnet_tpu import lr_scheduler as lrs
+    s = lrs.MultiFactorScheduler([3, 6], factor=0.1, base_lr=1.0)
+    assert abs(s(1) - 1.0) < 1e-9
+    assert abs(s(5) - 0.1) < 1e-9
+    assert abs(s(8) - 0.01) < 1e-9
+    p = lrs.PolyScheduler(max_update=10, base_lr=1.0, pwr=1)
+    assert abs(p(0) - 1.0) < 1e-9
+    assert p(9) < 0.2
+    c = lrs.CosineScheduler(max_update=10, base_lr=1.0, final_lr=0.0)
+    assert abs(c(0) - 1.0) < 1e-9
+    assert c(10) < 1e-6
+    w = lrs.FactorScheduler(step=100, base_lr=1.0, warmup_steps=5,
+                            warmup_begin_lr=0.0)
+    assert w(1) < w(4) < 1.0

@@ -1,0 +1,153 @@
+"""Parallelism tests on the 8-device CPU mesh (the analogue of the
+reference's `tools/launch.py --launcher local` multi-process fixtures,
+SURVEY.md §4)."""
+import functools
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import loss as gloss
+
+
+@pytest.fixture
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    m = parallel.device_mesh((8,), ("dp",))
+    old = parallel.get_mesh()
+    parallel.set_mesh(m)
+    yield m
+    parallel.set_mesh(old)
+
+
+def test_shard_batch_and_replicate(mesh8):
+    x = mx.nd.array(onp.arange(32, dtype="float32").reshape(16, 2))
+    xs = parallel.shard_batch(x, mesh8)
+    assert xs.shape == (16, 2)
+    onp.testing.assert_allclose(xs.asnumpy(), x.asnumpy())
+    w = parallel.replicate(mx.nd.ones((3, 3)), mesh8)
+    onp.testing.assert_allclose(w.asnumpy(), onp.ones((3, 3)))
+
+
+def test_data_parallel_step_descends(mesh8):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(16, 8).astype("float32"))
+    y = mx.nd.array(onp.random.randint(0, 4, 16).astype("float32"))
+    net(x)  # complete deferred init
+    L = gloss.SoftmaxCrossEntropyLoss()
+    step = parallel.DataParallelStep(
+        net, lambda o, l: L(o, l),
+        mx.optimizer.SGD(learning_rate=0.5, momentum=0.9), mesh=mesh8)
+    losses = [float(step(x, y).asscalar()) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_data_parallel_matches_single_device(mesh8):
+    """Sharded-step training must produce the same parameters as the
+    eager single-device Trainer (check_consistency analogue for DP)."""
+    onp.random.seed(0)
+    x = onp.random.randn(16, 8).astype("float32")
+    y = onp.random.randint(0, 4, 16).astype("float32")
+
+    def build():
+        onp.random.seed(42)
+        mx.random.seed(42)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(4))
+        net.initialize()
+        net(mx.nd.array(x))
+        return net
+
+    L = gloss.SoftmaxCrossEntropyLoss()
+
+    net_a = build()
+    step = parallel.DataParallelStep(
+        net_a, lambda o, l: L(o, l),
+        mx.optimizer.SGD(learning_rate=0.1), mesh=mesh8)
+    for _ in range(4):
+        step(mx.nd.array(x), mx.nd.array(y))
+
+    net_b = build()
+    trainer = gluon.Trainer(net_b.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    for _ in range(4):
+        with mx.autograd.record():
+            l = L(net_b(mx.nd.array(x)), mx.nd.array(y)).mean()
+        l.backward()
+        trainer.step(1)  # DataParallelStep takes the mean loss itself
+
+    for (ka, pa), (kb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        onp.testing.assert_allclose(
+            pa.data().asnumpy(), pb.data().asnumpy(), rtol=2e-4, atol=2e-5)
+
+
+def test_psum_in_shard_map(mesh8):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        return parallel.psum(x, "dp")
+
+    fn = shard_map(f, mesh=mesh8, in_specs=P("dp"), out_specs=P())
+
+    x = jnp.arange(8.0)
+    out = fn(x)
+    assert float(out[0]) == 28.0
+
+
+def _dense_attn(q, k, v, causal):
+    D = q.shape[-1]
+    T = q.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    if causal:
+        m = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(m[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    B, H, T, D = 2, 2, 64, 8
+    onp.random.seed(1)
+    q = jnp.asarray(onp.random.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(onp.random.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(onp.random.randn(B, H, T, D).astype("float32"))
+    mesh = parallel.device_mesh((8,), ("sp",))
+    ref = _dense_attn(q, k, v, causal)
+    out = parallel.ring_attention_sharded(q, k, v, mesh=mesh, causal=causal)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_attention_matches_dense(causal):
+    B, H, T, D = 2, 2, 100, 8  # non-divisible T exercises padding
+    onp.random.seed(2)
+    q = jnp.asarray(onp.random.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(onp.random.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(onp.random.randn(B, H, T, D).astype("float32"))
+    ref = _dense_attn(q, k, v, causal)
+    out = parallel.blockwise_attention(q, k, v, block_size=32, causal=causal)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+def test_tensor_parallel_matmul_mesh():
+    """2-D mesh dp×tp: a sharded matmul under jit produces the global
+    result (GSPMD inserts the collectives — SURVEY §2.3 TP row)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = parallel.device_mesh((4, 2), ("dp", "tp"))
+    x = jnp.asarray(onp.random.randn(8, 16).astype("float32"))
+    w = jnp.asarray(onp.random.randn(16, 32).astype("float32"))
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    ws = jax.device_put(w, NamedSharding(mesh, P(None, "tp")))
+    out = jax.jit(lambda a, b: a @ b)(xs, ws)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(x @ w),
+                                rtol=1e-4, atol=1e-5)

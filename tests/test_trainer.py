@@ -1,0 +1,155 @@
+"""Trainer + KVStore + metric tests (reference test_gluon_trainer.py /
+test_kvstore.py strategy)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, kvstore, metric
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import loss as gloss
+
+
+def _make_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def _train(net, trainer, n=8, bs=16):
+    L = gloss.SoftmaxCrossEntropyLoss()
+    onp.random.seed(0)
+    x = mx.nd.array(onp.random.randn(bs, 8).astype("float32"))
+    y = mx.nd.array(onp.random.randint(0, 4, bs).astype("float32"))
+    losses = []
+    for _ in range(n):
+        with autograd.record():
+            l = L(net(x), y)
+        l.backward()
+        trainer.step(bs)
+        losses.append(float(l.mean().asscalar()))
+    return losses
+
+
+@pytest.mark.parametrize("kv", ["local", "device", None])
+def test_trainer_descends(kv):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9},
+                            kvstore=kv)
+    losses = _train(net, trainer)
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_update_on_kvstore_false():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05},
+                            kvstore="local", update_on_kvstore=False)
+    losses = _train(net, trainer)
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    _train(net, trainer, n=2)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer2 = gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+    trainer2.load_states(f)
+    assert trainer2._optimizer.momentum == 0.9
+
+
+def test_trainer_lr():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.3})
+    assert abs(trainer.learning_rate - 0.3) < 1e-9
+    trainer.set_learning_rate(0.1)
+    assert abs(trainer.optimizer.lr - 0.1) < 1e-9
+
+
+def test_kvstore_push_pull():
+    kv = kvstore.create("local")
+    kv.init(3, mx.nd.ones((2, 2)))
+    kv.push(3, mx.nd.full((2, 2), 4.0))
+    out = mx.nd.zeros((2, 2))
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 2), 4.0))
+
+
+def test_kvstore_aggregation():
+    kv = kvstore.create("local")
+    kv.init("w", mx.nd.zeros((3,)))
+    # list push = multi-device gradient aggregation (reference Comm Reduce)
+    kv.push("w", [mx.nd.ones((3,)), mx.nd.ones((3,)), mx.nd.ones((3,))])
+    out = mx.nd.zeros((3,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((3,), 3.0))
+
+
+def test_kvstore_updater():
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv.init(0, mx.nd.zeros((2,)))
+    kv.push(0, mx.nd.ones((2,)))
+    out = mx.nd.zeros((2,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), -onp.ones(2))
+
+
+def test_kvstore_tpu_type():
+    kv = kvstore.create("tpu")
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.init(0, mx.nd.ones((2,)))
+    kv.push(0, mx.nd.full((2,), 2.0))
+    out = mx.nd.zeros((2,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2,), 2.0))
+
+
+def test_kvstore_dist_async_rejected():
+    with pytest.raises(mx.MXNetError):
+        kvstore.create("dist_async")
+
+
+def test_metrics():
+    m = metric.Accuracy()
+    pred = mx.nd.array(onp.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]]))
+    label = mx.nd.array(onp.array([1, 0, 0]))
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+    m2 = metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array(onp.random.rand(10, 5))
+    label = mx.nd.array(onp.random.randint(0, 5, 10))
+    m2.update([label], [pred])
+    assert m2.get()[1] >= 0
+
+    m3 = metric.MSE()
+    m3.update([mx.nd.zeros((4, 1))], [mx.nd.ones((4, 1))])
+    assert abs(m3.get()[1] - 1.0) < 1e-6
+
+    comp = metric.create(["acc", "mse"])
+    assert isinstance(comp, metric.CompositeEvalMetric)
+
+    cus = metric.create(lambda l, p: onp.abs(l - p).mean())
+    cus.update([mx.nd.zeros((2, 2))], [mx.nd.ones((2, 2))])
+    assert abs(cus.get()[1] - 1.0) < 1e-6
+
+    f1 = metric.F1()
+    p = mx.nd.array(onp.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7]]))
+    l = mx.nd.array(onp.array([1.0, 0.0, 1.0]))
+    f1.update([l], [p])
+    assert f1.get()[1] == 1.0
+
+    pp = metric.Perplexity(ignore_label=None)
+    prob = mx.nd.array(onp.full((4, 3), 1.0 / 3))
+    lbl = mx.nd.array(onp.array([0, 1, 2, 0]))
+    pp.update([lbl], [prob])
+    assert abs(pp.get()[1] - 3.0) < 1e-3
