@@ -162,7 +162,6 @@ def ring_attention_sharded(q, k, v, mesh=None, axis: str = "sp",
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis, causal=causal,
                           scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     out = fn(qv, kv_, vv)
     return _wrap(out, q.context) if isinstance(q, NDArray) else out
