@@ -118,6 +118,12 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     m0 = jnp.full((B, H, T_local), -1e30, jnp.float32)
     l0 = jnp.zeros((B, H, T_local), jnp.float32)
     o0 = jnp.zeros((B, H, T_local, D), jnp.float32)
+    # mark accumulators as device-varying along the ring axis so the scan
+    # carry type matches after the flash update (jax vma type system)
+    try:
+        m0, l0, o0 = (lax.pvary(a, (axis_name,)) for a in (m0, l0, o0))
+    except AttributeError:
+        pass
 
     def body(carry, _):
         m, l, o, k_cur, v_cur, src = carry
