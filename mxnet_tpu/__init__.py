@@ -28,6 +28,9 @@ from . import lr_scheduler
 from . import metric
 from . import kvstore
 from . import kvstore as kv
+from . import io
+from . import recordio
+from . import image
 from . import gluon
 from . import parallel
 
@@ -39,5 +42,5 @@ __all__ = [
     "current_context", "num_gpus", "num_tpus", "nd", "ndarray",
     "autograd", "random", "NDArray", "initializer", "init", "gluon",
     "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
-    "parallel",
+    "io", "recordio", "image", "parallel",
 ]

@@ -1,0 +1,104 @@
+"""DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``).
+
+The reference uses multiprocessing workers + POSIX-shm NDArray pickling
+(``dataloader.py:66-120``, C++ ``cpu_shared_storage_manager.h``) because
+Python decode is the bottleneck for GPU input pipelines.  Here workers are a
+``ThreadPoolExecutor``: batchification is numpy (releases the GIL in C),
+device transfer is a single async ``jax.device_put`` per batch, and thread
+workers avoid the fork-safety problems the reference needed
+``pthread_atfork`` engine restarts for (``src/initialize.cc:49-58``).  The
+``num_workers`` / ``pin_memory`` API is kept for parity.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as onp
+
+from ...ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return array(onp.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = onp.asarray(data)
+    return array(data)
+
+
+class DataLoader:
+    """Load batches from a Dataset (reference dataloader.py:169)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+        self._executor = None
+        if self._num_workers > 0:
+            self._executor = ThreadPoolExecutor(max_workers=self._num_workers)
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._executor is None:
+            for batch_indices in self._batch_sampler:
+                yield self._make_batch(batch_indices)
+            return
+
+        # pipelined: keep `prefetch` batches in flight
+        batches = iter(self._batch_sampler)
+        futures = []
+        try:
+            for _ in range(self._prefetch + 1):
+                futures.append(self._executor.submit(
+                    self._make_batch, next(batches)))
+        except StopIteration:
+            pass
+        while futures:
+            f = futures.pop(0)
+            try:
+                futures.append(self._executor.submit(
+                    self._make_batch, next(batches)))
+            except StopIteration:
+                pass
+            yield f.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,8 @@
+"""Vision datasets + transforms (reference ``python/mxnet/gluon/data/vision/``)."""
+from .datasets import (  # noqa: F401
+    MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset,
+    ImageFolderDataset)
+from . import transforms  # noqa: F401
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "transforms"]

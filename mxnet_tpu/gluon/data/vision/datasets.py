@@ -1,0 +1,230 @@
+"""Vision datasets (reference ``python/mxnet/gluon/data/vision/datasets.py``).
+
+Loads from local files only — this build targets air-gapped TPU hosts, so
+``root`` must contain the standard files (the reference downloads them from
+a repo URL; the formats are identical).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import warnings
+
+import numpy as onp
+
+from ....ndarray import array
+from ..dataset import ArrayDataset, Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    """Base for file-backed datasets (reference datasets.py:44)."""
+
+    def __init__(self, root, transform):
+        super().__init__()
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, "bad idx3 magic in %s" % path
+        return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(
+            num, rows, cols, 1)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        assert magic == 2049, "bad idx1 magic in %s" % path
+        return onp.frombuffer(f.read(), dtype=onp.uint8).astype(onp.int32)
+
+
+def _find(root, names):
+    for n in names:
+        for cand in (os.path.join(root, n), os.path.join(root, n + ".gz")):
+            if os.path.exists(cand):
+                return cand
+    raise IOError(
+        "Dataset file not found under %s (looked for %s). This build has no "
+        "network access — place the standard files there." % (root, names))
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST (reference datasets.py:61; same idx-ubyte format as
+    src/io/iter_mnist.cc)."""
+
+    _train_images = ["train-images-idx3-ubyte"]
+    _train_labels = ["train-labels-idx1-ubyte"]
+    _test_images = ["t10k-images-idx3-ubyte"]
+    _test_labels = ["t10k-labels-idx1-ubyte"]
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        images = _find(self._root, self._train_images if self._train
+                       else self._test_images)
+        labels = _find(self._root, self._train_labels if self._train
+                       else self._test_labels)
+        self._label = _read_idx_labels(labels)
+        self._data = array(_read_idx_images(images))
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST (reference datasets.py:117) — same format, different
+    root."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches (reference datasets.py:153
+    reads the binary format; the python format is more commonly available)."""
+
+    _train_files = ["data_batch_1", "data_batch_2", "data_batch_3",
+                    "data_batch_4", "data_batch_5"]
+    _test_files = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _load_batch(self, path):
+        with open(path, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        labels = onp.asarray(d[self._label_key], onp.int32)
+        return data, labels
+
+    def _get_data(self):
+        sub = None
+        for cand in (self._root,
+                     os.path.join(self._root, "cifar-10-batches-py"),
+                     os.path.join(self._root, "cifar-100-python")):
+            if os.path.exists(os.path.join(
+                    cand, (self._train_files if self._train
+                           else self._test_files)[0])):
+                sub = cand
+                break
+        if sub is None:
+            raise IOError(
+                "CIFAR batches not found under %s. This build has no network "
+                "access — place the python-format batches there." % self._root)
+        files = self._train_files if self._train else self._test_files
+        parts = [self._load_batch(os.path.join(sub, f)) for f in files]
+        self._data = array(onp.concatenate([p[0] for p in parts]))
+        self._label = onp.concatenate([p[1] for p in parts])
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (reference datasets.py:212)."""
+
+    _train_files = ["train"]
+    _test_files = ["test"]
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._label_key = b"fine_labels" if fine_label else b"coarse_labels"
+        super().__init__(root, train, transform)
+
+
+class ImageRecordDataset(Dataset):
+    """Images + labels from a RecordIO pack (reference datasets.py:257 over
+    ImageRecordIter's format; decoding via mxnet_tpu.image)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._record)
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack
+        from ....image import imdecode
+        record = self._record[idx]
+        header, img_bytes = unpack(record)
+        img = imdecode(img_bytes, flag=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """folder/label/img.jpg layout (reference datasets.py:300)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".npy"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                warnings.warn("Ignoring %s, which is not a directory." % path)
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    warnings.warn(
+                        "Ignoring %s of type %s. Only support %s" % (
+                            filename, ext, ", ".join(self._exts)))
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        path, label = self.items[idx]
+        if path.endswith(".npy"):
+            img = array(onp.load(path))
+        else:
+            img = imread(path, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

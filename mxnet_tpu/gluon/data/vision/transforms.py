@@ -1,0 +1,259 @@
+"""Vision transforms (reference ``python/mxnet/gluon/data/vision/transforms.py``).
+
+Transforms are Blocks operating on HWC uint8/float images (the reference
+convention); ``ToTensor`` converts to CHW float32 in [0,1].
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as onp
+
+from ....ndarray import NDArray, array
+from ....ndarray.ndarray import invoke_fn
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomLighting", "RandomColorJitter"]
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (reference transforms.py:33)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    """(reference transforms.py:70)"""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC [0,255] uint8 → CHW [0,1] float32 (reference transforms.py:91)."""
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+
+        def fn(v):
+            v = v.astype(jnp.float32) / 255.0
+            if v.ndim == 3:
+                return jnp.transpose(v, (2, 0, 1))
+            return jnp.transpose(v, (0, 3, 1, 2))
+        return invoke_fn(fn, [x], name="to_tensor")
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW (reference transforms.py:131)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+
+        def fn(v):
+            mean = jnp.asarray(self._mean, v.dtype)
+            std = jnp.asarray(self._std, v.dtype)
+            if mean.ndim == 1:
+                shape = (-1,) + (1,) * (v.ndim - 1 - (v.ndim == 4))
+                mean = mean.reshape(shape)
+                std = std.reshape(shape)
+            return (v - mean) / std
+        return invoke_fn(fn, [x], name="normalize")
+
+
+def _resize_np(img, size, interp=1):
+    import cv2
+    if isinstance(size, int):
+        h, w = img.shape[:2]
+        if h < w:
+            new_h, new_w = size, int(w * size / h)
+        else:
+            new_h, new_w = int(h * size / w), size
+    else:
+        new_w, new_h = size
+    out = cv2.resize(img, (new_w, new_h),
+                     interpolation={0: 0, 1: 1, 2: 2, 3: 3, 4: 4}.get(interp, 1))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+class Resize(Block):
+    """Resize HWC image (reference transforms.py:187; OpenCV-backed like the
+    reference's image.imresize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if not keep_ratio or isinstance(size, int) else size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+        return array(_resize_np(img, self._size, self._interpolation),
+                     dtype=img.dtype)
+
+
+class CenterCrop(Block):
+    """(reference transforms.py:259)"""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+        h, w = img.shape[:2]
+        cw, ch = self._size
+        if h < ch or w < cw:
+            img = _resize_np(img, (max(cw, w), max(ch, h)), self._interpolation)
+            h, w = img.shape[:2]
+        y0 = (h - ch) // 2
+        x0 = (w - cw) // 2
+        return array(img[y0:y0 + ch, x0:x0 + cw], dtype=img.dtype)
+
+
+class RandomResizedCrop(Block):
+    """Random area+aspect crop then resize (reference transforms.py:219)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self._scale) * area
+            aspect = random.uniform(*self._ratio)
+            new_w = int(round((target_area * aspect) ** 0.5))
+            new_h = int(round((target_area / aspect) ** 0.5))
+            if random.random() < 0.5:
+                new_w, new_h = new_h, new_w
+            if new_w <= w and new_h <= h:
+                x0 = random.randint(0, w - new_w)
+                y0 = random.randint(0, h - new_h)
+                crop = img[y0:y0 + new_h, x0:x0 + new_w]
+                return array(_resize_np(crop, self._size, self._interpolation),
+                             dtype=img.dtype)
+        return CenterCrop(self._size, self._interpolation).forward(
+            array(img, dtype=img.dtype))
+
+
+class RandomFlipLeftRight(Block):
+    """(reference transforms.py:301)"""
+
+    def forward(self, x):
+        if random.random() < 0.5:
+            img = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            return array(img[:, ::-1].copy(), dtype=img.dtype)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    """(reference transforms.py:318)"""
+
+    def forward(self, x):
+        if random.random() < 0.5:
+            img = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            return array(img[::-1].copy(), dtype=img.dtype)
+        return x
+
+
+class _RandomColorBase(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _alpha(self):
+        return 1.0 + random.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomColorBase):
+    """(reference transforms.py:335)"""
+
+    def forward(self, x):
+        img = (x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)).astype("float32")
+        return array(img * self._alpha(), dtype="float32")
+
+
+class RandomContrast(_RandomColorBase):
+    """(reference transforms.py:354)"""
+
+    def forward(self, x):
+        img = (x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)).astype("float32")
+        coef = onp.array([0.299, 0.587, 0.114], "float32")
+        alpha = self._alpha()
+        gray = (img * coef).sum(axis=-1, keepdims=True).mean()
+        return array(img * alpha + gray * (1 - alpha), dtype="float32")
+
+
+class RandomSaturation(_RandomColorBase):
+    """(reference transforms.py:374)"""
+
+    def forward(self, x):
+        img = (x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)).astype("float32")
+        coef = onp.array([0.299, 0.587, 0.114], "float32")
+        alpha = self._alpha()
+        gray = (img * coef).sum(axis=-1, keepdims=True)
+        return array(img * alpha + gray * (1 - alpha), dtype="float32")
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference transforms.py:414)."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], "float32")
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], "float32")
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        img = (x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)).astype("float32")
+        alpha = onp.random.normal(0, self._alpha, 3).astype("float32")
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return array(img + rgb, dtype="float32")
+
+
+class RandomColorJitter(Block):
+    """brightness+contrast+saturation jitter (reference transforms.py:394)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        ts = list(self._ts)
+        random.shuffle(ts)
+        for t in ts:
+            x = t.forward(x)
+        return x

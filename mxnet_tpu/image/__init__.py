@@ -1,0 +1,19 @@
+"""Image I/O + augmentation (reference ``python/mxnet/image/``)."""
+from .image import (  # noqa: F401
+    imdecode, imread, imresize, imrotate, resize_short, fixed_crop,
+    random_crop, center_crop, color_normalize, random_size_crop,
+    Augmenter, SequentialAug, RandomOrderAug, ResizeAug, ForceResizeAug,
+    CastAug, RandomCropAug, RandomSizedCropAug, CenterCropAug,
+    HorizontalFlipAug, BrightnessJitterAug, ContrastJitterAug,
+    SaturationJitterAug, ColorJitterAug, LightingAug, ColorNormalizeAug,
+    CreateAugmenter, ImageIter)
+
+__all__ = [
+    "imdecode", "imread", "imresize", "imrotate", "resize_short",
+    "fixed_crop", "random_crop", "center_crop", "color_normalize",
+    "random_size_crop", "Augmenter", "SequentialAug", "RandomOrderAug",
+    "ResizeAug", "ForceResizeAug", "CastAug", "RandomCropAug",
+    "RandomSizedCropAug", "CenterCropAug", "HorizontalFlipAug",
+    "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+    "ColorJitterAug", "LightingAug", "ColorNormalizeAug", "CreateAugmenter",
+    "ImageIter"]

@@ -1,0 +1,480 @@
+"""Image decode + augmentation pipeline.
+
+Reference: ``python/mxnet/image/image.py`` — ``imdecode`` (:143), aug
+pipeline ``CreateAugmenter`` (:605), ``ImageIter`` (:1129).  OpenCV-backed
+host-side numpy, like the reference; the TPU sees only the final batched
+``device_put``.  Augmenters here work on HWC numpy arrays (RGB order, as the
+reference's imdecode produces after its BGR→RGB flip).
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+from ..io.io import DataIter, DataBatch, DataDesc
+
+
+def _np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer → HWC NDArray (reference image.py:143)."""
+    import cv2
+    if isinstance(buf, (bytes, bytearray)):
+        buf = onp.frombuffer(buf, onp.uint8)
+    img = cv2.imdecode(buf, flag)
+    if img is None:
+        raise MXNetError("Decoding image failed")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return array(img, dtype=onp.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """(reference image.py imread via cv2)"""
+    import cv2
+    img = cv2.imread(filename, flag)
+    if img is None:
+        raise MXNetError("Reading image %s failed" % filename)
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return array(img, dtype=onp.uint8)
+
+
+def imresize(src, w, h, interp=1):
+    """(reference image.py imresize)"""
+    import cv2
+    img = cv2.resize(_np(src), (w, h), interpolation=interp)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return array(img, dtype=img.dtype)
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """(reference image.py imrotate)"""
+    import cv2
+    img = _np(src)
+    h, w = img.shape[:2]
+    m = cv2.getRotationMatrix2D((w / 2, h / 2), rotation_degrees, 1.0)
+    out = cv2.warpAffine(img, m, (w, h))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return array(out, dtype=img.dtype)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the short side equals size (reference image.py:372)."""
+    img = _np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(img, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """(reference image.py:410)"""
+    img = _np(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return array(out, dtype=img.dtype)
+
+
+def random_crop(src, size, interp=2):
+    """(reference image.py:437)"""
+    img = _np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """(reference image.py:476)"""
+    img = _np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """(reference image.py:546)"""
+    img = _np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = random.uniform(*area) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        new_ratio = onp.exp(random.uniform(*log_ratio))
+        new_w = int(round((target_area * new_ratio) ** 0.5))
+        new_h = int(round((target_area / new_ratio) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(img, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    """(reference image.py:588)"""
+    img = _np(src).astype("float32")
+    img = img - _np(mean)
+    if std is not None:
+        img = img / _np(std)
+    return array(img, dtype="float32")
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (reference image.py:660-1120)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    """Base augmenter (reference image.py:660)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            img = _np(src)
+            return array(img[:, ::-1].copy(), dtype=img.dtype)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return array(_np(src).astype(self.typ), dtype=self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return array(_np(src).astype("float32") * alpha, dtype="float32")
+
+
+class ContrastJitterAug(Augmenter):
+    coef = onp.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _np(src).astype("float32")
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        gray = (img * self.coef).sum(axis=-1, keepdims=True).mean()
+        return array(img * alpha + gray * (1 - alpha), dtype="float32")
+
+
+class SaturationJitterAug(Augmenter):
+    coef = onp.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _np(src).astype("float32")
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        gray = (img * self.coef).sum(axis=-1, keepdims=True)
+        return array(img * alpha + gray * (1 - alpha), dtype="float32")
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet PCA lighting noise (reference image.py:969)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = eigval
+        self.eigvec = eigvec
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,)).astype("float32")
+        rgb = onp.dot(self.eigvec * alpha, self.eigval)
+        return array(_np(src).astype("float32") + rgb, dtype="float32")
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = onp.asarray(mean, "float32") if mean is not None else None
+        self.std = onp.asarray(std, "float32") if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard training pipeline (reference image.py:605)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over recordio or image lists with augmentation
+    (reference image.py:1129; C++ analogue iter_image_recordio_2.cc)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3 and data_shape[0] == 3
+        self.data_shape = data_shape
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self.record = None
+        self.imglist = {}
+        self.seq = []
+        if path_imgrec is not None:
+            from ..recordio import MXIndexedRecordIO
+            import os
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.record = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self.record.keys)
+        elif path_imglist is not None:
+            with open(path_imglist) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    label = onp.array(line[1:-1], "float32")
+                    key = int(line[0])
+                    self.imglist[key] = (label, line[-1])
+                    self.seq.append(key)
+            self.path_root = path_root
+        elif imglist is not None:
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (onp.array(label, "float32").reshape(-1), fname)
+                self.seq.append(i)
+            self.path_root = path_root
+        else:
+            raise ValueError("Either path_imgrec, path_imglist or imglist "
+                             "must be provided")
+        self.shuffle = shuffle
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast", "saturation",
+                         "pca_noise", "inter_method")})
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + tuple(self.data_shape))]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.record is not None:
+            from ..recordio import unpack
+            header, img_bytes = unpack(self.record.read_idx(idx))
+            return header.label, imdecode(img_bytes)
+        label, fname = self.imglist[idx]
+        import os
+        return label, imread(os.path.join(self.path_root, fname))
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = onp.zeros((self.batch_size, h, w, c), "float32")
+        batch_label = onp.zeros((self.batch_size, self.label_width), "float32")
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, img = self.next_sample()
+                for aug in self.auglist:
+                    img = aug(img)
+                data = _np(img)
+                assert data.shape[:2] == (h, w), \
+                    "augmented image shape %s != data_shape %s" % (
+                        data.shape, (h, w))
+                batch_data[i] = data
+                batch_label[i] = label
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        # NCHW for the model (reference postprocess_data transposes)
+        nchw = onp.transpose(batch_data, (0, 3, 1, 2))
+        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch([array(nchw)], [array(label_out)], pad=pad)

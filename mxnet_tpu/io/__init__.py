@@ -1,0 +1,7 @@
+"""Data iterators (reference ``python/mxnet/io/``)."""
+from .io import (  # noqa: F401
+    DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
+    CSVIter, MNISTIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter"]

@@ -1,0 +1,214 @@
+"""RecordIO: the reference's packed-record file format, bit-compatible.
+
+Reference: ``python/mxnet/recordio.py`` (``MXRecordIO`` :37,
+``MXIndexedRecordIO`` :216, ``IRHeader``/pack/unpack :344-397) over
+dmlc-core's recordio writer; C++ reader ``src/io/``.  Pure-Python here —
+record framing is cheap; image decode (the hot part) happens in
+``mxnet_tpu.image`` via OpenCV exactly like the reference's OMP decode
+workers.
+
+Format (dmlc recordio): every record is
+``uint32 kMagic=0xced7230a | uint32 lrec | payload | pad-to-4``, where
+lrec's upper 3 bits are a continuation flag (unused for whole records) and
+lower 29 bits the payload length.  ``pack``/``unpack`` add the IRHeader
+(flag, label, id, id2) used by ImageRecordIter.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as onp
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_K_MAGIC = 0xced7230a
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference recordio.py:37)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fh = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fh = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        del d["fh"]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        # fork safety (reference recordio.py:137 re-opens after fork)
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("Forbidden operation in forked process")
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.fh.close()
+        self.is_open = False
+        self.pid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        self.fh.write(struct.pack("<II", _K_MAGIC, len(buf) & ((1 << 29) - 1)))
+        self.fh.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.fh.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        head = self.fh.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _K_MAGIC:
+            raise IOError("Invalid RecordIO magic in %s" % self.uri)
+        length = lrec & ((1 << 29) - 1)
+        buf = self.fh.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fh.read(pad)
+        return buf
+
+    def tell(self):
+        return self.fh.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.fh.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via a .idx sidecar (reference recordio.py:216)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Prepend an IRHeader to a byte string (reference recordio.py:344)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+    else:
+        label = onp.asarray(header.label, onp.float32)
+        hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2) \
+            + label.tobytes()
+    return hdr + s
+
+
+def unpack(s):
+    """Split a record into (IRHeader, payload) (reference recordio.py:367)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = onp.frombuffer(s[:flag * 4], onp.float32)
+        s = s[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (reference recordio.py:389; cv2.imencode)."""
+    import cv2
+    encode_params = None
+    if img_fmt.lower() in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt.lower() == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """(reference recordio.py:412)"""
+    import cv2
+    header, s = unpack(s)
+    img = onp.frombuffer(s, dtype=onp.uint8)
+    img = cv2.imdecode(img, iscolor)
+    return header, img

@@ -1,0 +1,210 @@
+"""IO + gluon.data + recordio + image tests (reference test_io.py /
+test_gluon_data.py / test_recordio.py / test_image.py strategies)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, recordio
+from mxnet_tpu.gluon import data as gdata
+
+
+def test_ndarray_iter_basic():
+    data = onp.arange(40, dtype="float32").reshape(10, 4)
+    label = onp.arange(10, dtype="float32")
+    it = io.NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:3])
+    assert batches[-1].pad == 2
+    # pad wraps around to the beginning
+    onp.testing.assert_allclose(batches[-1].data[0].asnumpy()[1:],
+                                data[[9, 0]][1:] if False else data[:2])
+
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard():
+    data = onp.arange(40, dtype="float32").reshape(10, 4)
+    it = io.NDArrayIter(data, None, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_shuffle():
+    data = onp.arange(100, dtype="float32").reshape(100, 1)
+    it = io.NDArrayIter(data, data[:, 0].copy(), batch_size=10, shuffle=True)
+    batch = next(it)
+    onp.testing.assert_allclose(batch.data[0].asnumpy()[:, 0],
+                                batch.label[0].asnumpy())
+
+
+def test_ndarray_iter_dict_input():
+    it = io.NDArrayIter({"a": onp.zeros((6, 2)), "b": onp.ones((6, 3))},
+                        onp.arange(6), batch_size=2)
+    assert {d.name for d in it.provide_data} == {"a", "b"}
+    b = next(it)
+    assert len(b.data) == 2
+
+
+def test_resize_iter():
+    data = onp.zeros((10, 2), "float32")
+    base = io.NDArrayIter(data, batch_size=5)
+    it = io.ResizeIter(base, size=7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = onp.arange(20, dtype="float32").reshape(10, 2)
+    base = io.NDArrayIter(data, onp.arange(10, dtype="float32"), batch_size=5)
+    it = io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 2
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_dataset_and_dataloader():
+    x = onp.random.randn(20, 3).astype("float32")
+    y = onp.arange(20, dtype="float32")
+    ds = gdata.ArrayDataset(x, y)
+    assert len(ds) == 20
+    item = ds[3]
+    onp.testing.assert_allclose(item[0], x[3])
+
+    dl = gdata.DataLoader(ds, batch_size=6, last_batch="keep")
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    assert batches[-1][0].shape == (2, 3)
+
+    dl2 = gdata.DataLoader(ds, batch_size=6, shuffle=True, last_batch="discard",
+                           num_workers=2)
+    batches = list(dl2)
+    assert len(batches) == 3
+
+
+def test_dataset_transform_shard():
+    ds = gdata.SimpleDataset(list(range(10)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    sh = ds.shard(3, 0)
+    assert len(sh) == 4  # 10 = 4+3+3
+    assert sh[0] == 0
+    sh2 = ds.shard(3, 1)
+    assert sh2[0] == 4
+
+
+def test_batch_sampler_rollover():
+    s = gdata.BatchSampler(gdata.SequentialSampler(10), 4, "rollover")
+    first = list(s)
+    assert len(first) == 2
+    second = list(s)
+    assert second[0][:2] == [8, 9]
+
+
+def test_recordio_roundtrip(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(fname, "w")
+    for i in range(5):
+        w.write(b"record%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(fname, "r")
+    for i in range(5):
+        assert r.read() == b"record%d" % i
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    idxname = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idxname, fname, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    r.close()
+
+
+def test_pack_unpack():
+    hdr = recordio.IRHeader(0, 7.0, 42, 0)
+    s = recordio.pack(hdr, b"payload")
+    hdr2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert hdr2.label == 7.0 and hdr2.id == 42
+    # multi-label
+    hdr3 = recordio.IRHeader(0, onp.array([1.0, 2.0, 3.0], "float32"), 1, 0)
+    s3 = recordio.pack(hdr3, b"x")
+    hdr4, p4 = recordio.unpack(s3)
+    onp.testing.assert_allclose(hdr4.label, [1, 2, 3])
+
+
+def test_image_pack_img_and_dataset(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu import image as mimg
+    fname = str(tmp_path / "imgs.rec")
+    idxname = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idxname, fname, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(4):
+        img = rng.randint(0, 255, (32, 32, 3), dtype=onp.uint8)
+        s = recordio.pack_img(recordio.IRHeader(0, float(i), i, 0), img,
+                              quality=100, img_fmt=".png")
+        w.write_idx(i, s)
+    w.close()
+
+    ds = gdata.vision.ImageRecordDataset(fname)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert img.shape == (32, 32, 3)
+    assert float(label) == 2.0
+
+    it = mimg.ImageIter(batch_size=2, data_shape=(3, 28, 28),
+                        path_imgrec=fname, rand_crop=True, rand_mirror=True)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 28, 28)
+
+
+def test_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = mx.nd.array(onp.random.randint(0, 255, (32, 30, 3)), dtype="uint8")
+    t = T.ToTensor()(img)
+    assert t.shape == (3, 32, 30)
+    assert float(t.max().asscalar()) <= 1.0
+    n = T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))(t)
+    assert n.shape == (3, 32, 30)
+    r = T.Resize((16, 16))(img)
+    assert r.shape[:2] == (16, 16)
+    c = T.CenterCrop(8)(img)
+    assert c.shape[:2] == (8, 8)
+    rc = T.RandomResizedCrop(12)(img)
+    assert rc.shape[:2] == (12, 12)
+    comp = T.Compose([T.Resize(20), T.ToTensor()])
+    out = comp(img)
+    assert out.shape[0] == 3
+
+
+def test_mnist_iter_synthetic(tmp_path):
+    """MNISTIter reads the idx-ubyte format (write a tiny synthetic file)."""
+    import struct
+    rng = onp.random.RandomState(0)
+    images = rng.randint(0, 255, (10, 28, 28), dtype=onp.uint8)
+    labels = rng.randint(0, 10, 10).astype(onp.uint8)
+    img_f = str(tmp_path / "train-images-idx3-ubyte")
+    lab_f = str(tmp_path / "train-labels-idx1-ubyte")
+    with open(img_f, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 10, 28, 28))
+        f.write(images.tobytes())
+    with open(lab_f, "wb") as f:
+        f.write(struct.pack(">II", 2049, 10))
+        f.write(labels.tobytes())
+    it = io.MNISTIter(image=img_f, label=lab_f, batch_size=5, flat=False,
+                      shuffle=False)
+    b = next(it)
+    assert b.data[0].shape == (5, 1, 28, 28)
+    assert float(b.data[0].max().asscalar()) <= 1.0

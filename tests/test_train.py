@@ -1,0 +1,115 @@
+"""End-to-end training tests (reference tests/python/train/test_mlp.py /
+test_conv.py: train a few epochs on a small problem, assert accuracy)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, io, metric
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import loss as gloss
+
+
+def _synthetic_mnist(n=512, seed=0):
+    """Linearly-separable-ish 10-class blobs in 784-d (stands in for MNIST
+    on the air-gapped test host; difficulty tuned so an MLP must learn)."""
+    rng = onp.random.RandomState(seed)
+    centers = rng.randn(10, 784).astype("float32") * 2.0
+    y = rng.randint(0, 10, n)
+    x = centers[y] + rng.randn(n, 784).astype("float32")
+    return x.astype("float32"), y.astype("float32")
+
+
+def test_mlp_converges():
+    """Gluon MLP reaches >95% train accuracy (BASELINE config 1 analogue)."""
+    x, y = _synthetic_mnist()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    train_iter = io.NDArrayIter(x, y, batch_size=64, shuffle=True,
+                                last_batch_handle="discard")
+    for epoch in range(5):
+        train_iter.reset()
+        for batch in train_iter:
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(data)
+                loss = L(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+    acc = metric.Accuracy()
+    out = net(mx.nd.array(x))
+    acc.update([mx.nd.array(y)], [out])
+    assert acc.get()[1] > 0.95, "MLP failed to converge: %s" % (acc.get(),)
+
+
+def test_conv_net_trains():
+    """Small CNN on image-shaped data descends (test_conv.py analogue)."""
+    rng = onp.random.RandomState(1)
+    x = rng.randn(64, 1, 12, 12).astype("float32")
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype("float32")
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, activation="relu"),
+            nn.MaxPool2D(pool_size=2),
+            nn.Flatten(),
+            nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    xs, ys = mx.nd.array(x), mx.nd.array(y)
+    losses = []
+    for i in range(15):
+        with autograd.record():
+            loss = L(net(xs), ys).mean()
+        loss.backward()
+        trainer.step(64)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """save_parameters/load_parameters preserves behavior (reference
+    checkpoint tests; SURVEY §5.4)."""
+    x = mx.nd.array(onp.random.randn(4, 16).astype("float32"))
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_training_with_dataloader():
+    x, y = _synthetic_mnist(n=256, seed=3)
+    from mxnet_tpu.gluon import data as gdata
+    ds = gdata.ArrayDataset(x, y)
+    dl = gdata.DataLoader(ds, batch_size=32, shuffle=True, last_batch="discard")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    first = last = None
+    for epoch in range(3):
+        for data, label in dl:
+            with autograd.record():
+                loss = L(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            v = float(loss.mean().asscalar())
+            if first is None:
+                first = v
+            last = v
+    assert last < first
