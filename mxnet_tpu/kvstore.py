@@ -74,7 +74,14 @@ class KVStore:
     def set_gradient_compression(self, compression_params):
         """Accepted for API parity (reference kvstore.py:394).  ICI
         collectives are not bandwidth-bound at MXNet's model scale, so
-        compression is recorded but not applied."""
+        compression is recorded but not applied; a warning makes the
+        descope visible instead of silent."""
+        import warnings
+        warnings.warn(
+            "gradient compression is a no-op on the TPU build: ICI "
+            "all-reduce is not bandwidth-bound at these model sizes; "
+            "parameters are accepted for API compatibility only.",
+            stacklevel=2)
         self._compression_params = compression_params
 
     def set_optimizer(self, optimizer):

@@ -430,8 +430,15 @@ class Perplexity(EvalMetric):
                 probs = probs * (1 - ignore) + ignore
             loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
             num += label.size
-        self.sum_metric += numpy.exp(loss / num) * num
+        # accumulate raw log-loss; get() exponentiates the global mean so
+        # multi-batch evaluation is exact (reference metric.py:826)
+        self.sum_metric += loss
         self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
 # ---------------------------------------------------------------------------

@@ -663,9 +663,9 @@ def split(data, num_outputs: int, axis: int = 1, squeeze_axis: bool = False):
 
 def waitall():
     """Block until all async computation is complete (reference
-    ndarray.py:157 — engine WaitForAll ⇒ here effectively a fence; individual
-    arrays are fenced by wait_to_read)."""
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
+    ndarray.py:157 — engine WaitForAll).  Async dispatch errors propagate
+    here, matching the reference's exception-at-waitall contract
+    (threaded_engine.h:492-499)."""
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()

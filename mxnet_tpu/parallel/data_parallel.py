@@ -85,10 +85,16 @@ class DataParallelStep:
             jfn = self._build()
             self._cache[key] = jfn
         self._t += 1
+        # advance the optimizer's clock and read the *current* scheduled lr
+        # per slot — passed traced so warmup/decay advance inside the cached
+        # compiled step (the reference re-reads the schedule per update too)
+        self._opt.num_update = max(self._opt.num_update, self._t)
+        lrs = jnp.asarray(
+            self._opt._get_lrs(list(range(len(self._trainable)))), jnp.float32)
         pvals = [p._data._data for p in self._params]
         rng = _random.next_key()
         new_pvals, new_states, loss = jfn(
-            pvals, self._opt_states, jnp.asarray(self._t, jnp.int32), rng,
+            pvals, self._opt_states, jnp.asarray(self._t, jnp.int32), lrs, rng,
             dval, lval)
         for p, v in zip(self._params, new_pvals):
             with autograd.pause():
@@ -136,7 +142,7 @@ class DataParallelStep:
                     p._data._data = old
                     p._data._ag = ag
 
-        def step_fn(pvals, opt_states, t, rng, dval, lval):
+        def step_fn(pvals, opt_states, t, lrs, rng, dval, lval):
             train_vals = [pvals[i] for i in trainable]
 
             def loss_of(tvals):
@@ -152,7 +158,10 @@ class DataParallelStep:
             new_states = []
             for slot, (i, g) in enumerate(zip(trainable, grads)):
                 st_leaves = opt_states[slot]
-                res = steps[slot](pvals[i], g, t, *st_leaves)
+                # cast to the weight dtype so a strong f32 lr never upcasts
+                # bf16/fp16 params through the update arithmetic
+                res = steps[slot](pvals[i], g, t,
+                                  lrs[slot].astype(pvals[i].dtype), *st_leaves)
                 new_pvals[i] = res[0]
                 new_states.append(list(res[1:]))
             for i, v in mutated.items():

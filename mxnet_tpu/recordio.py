@@ -15,6 +15,7 @@ lower 29 bits the payload length.  ``pack``/``unpack`` add the IRHeader
 """
 from __future__ import annotations
 
+import numbers
 import os
 import struct
 from collections import namedtuple
@@ -171,7 +172,7 @@ class MXIndexedRecordIO(MXRecordIO):
 def pack(header, s):
     """Prepend an IRHeader to a byte string (reference recordio.py:344)."""
     header = IRHeader(*header)
-    if isinstance(header.label, (int, float)):
+    if isinstance(header.label, numbers.Number):
         hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
                           header.id2)
     else:

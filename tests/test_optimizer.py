@@ -146,3 +146,30 @@ def test_schedulers():
     w = lrs.FactorScheduler(step=100, base_lr=1.0, warmup_steps=5,
                             warmup_begin_lr=0.0)
     assert w(1) < w(4) < 1.0
+
+
+def test_perplexity_multibatch_exact():
+    """Perplexity over several batches must equal exp(total_logloss/total_n)
+    (reference metric.py:826), not a weighted mean of per-batch values."""
+    import math
+    onp.random.seed(3)
+    m = mx.metric.Perplexity(ignore_label=None)
+    total_loss, total_n = 0.0, 0
+    for _ in range(3):
+        n, k = 5, 4
+        logits = onp.random.rand(n, k).astype("float32")
+        probs = logits / logits.sum(axis=1, keepdims=True)
+        labels = onp.random.randint(0, k, n)
+        m.update([mx.nd.array(labels)], [mx.nd.array(probs)])
+        total_loss -= onp.log(probs[onp.arange(n), labels]).sum()
+        total_n += n
+    name, val = m.get()
+    onp.testing.assert_allclose(val, math.exp(total_loss / total_n), rtol=1e-5)
+
+
+def test_optimizer_learning_rate_property_scheduled():
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.1)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert opt.learning_rate == 1.0
+    opt.num_update = 2
+    assert abs(opt.learning_rate - 0.1) < 1e-12

@@ -151,3 +151,30 @@ def test_tensor_parallel_matmul_mesh():
     out = jax.jit(lambda a, b: a @ b)(xs, ws)
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(x @ w),
                                 rtol=1e-4, atol=1e-5)
+
+
+def test_data_parallel_step_advances_lr_schedule(mesh8):
+    """The lr schedule must advance inside the cached compiled step: with
+    FactorScheduler(step=2, factor=0.5) and SGD, the weight deltas must
+    shrink by the schedule, not stay frozen at the step-0 lr."""
+    net = nn.Dense(1, use_bias=False, in_units=1)
+    net.initialize()
+    net(mx.nd.ones((4, 1)))
+    w0 = float(net.weight.data().asnumpy()[0, 0])
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    # loss = mean(w*x) with x=1 → dL/dw = 1 exactly, so each update moves
+    # w by exactly the scheduled lr
+    step = parallel.DataParallelStep(
+        net, lambda o, l: o, opt, mesh=mesh8)
+    x = mx.nd.ones((8, 1))
+    y = mx.nd.zeros((8,))
+    deltas = []
+    prev = w0
+    for _ in range(4):
+        step(x, y)
+        cur = float(net.weight.data().asnumpy()[0, 0])
+        deltas.append(prev - cur)
+        prev = cur
+    # updates 1,2 at lr=1.0; updates 3,4 at lr=0.5
+    onp.testing.assert_allclose(deltas, [1.0, 1.0, 0.5, 0.5], rtol=1e-5)
