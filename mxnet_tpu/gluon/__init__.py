@@ -4,6 +4,7 @@ from . import nn  # noqa: F401
 from . import utils  # noqa: F401
 from . import loss  # noqa: F401
 from . import data  # noqa: F401
+from . import model_zoo  # noqa: F401
 from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .parameter import (  # noqa: F401
     Constant, DeferredInitializationError, Parameter, ParameterDict)
@@ -11,7 +12,8 @@ from .trainer import Trainer  # noqa: F401
 
 from .utils import split_and_load, split_data  # noqa: F401
 
-__all__ = ["nn", "utils", "loss", "data", "Block", "HybridBlock", "SymbolBlock",
+__all__ = ["nn", "utils", "loss", "data", "model_zoo",
+           "Block", "HybridBlock", "SymbolBlock",
            "Parameter", "Constant", "ParameterDict",
            "DeferredInitializationError", "Trainer",
            "split_and_load", "split_data"]

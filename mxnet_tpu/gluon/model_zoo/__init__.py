@@ -1,0 +1,10 @@
+"""Gluon model zoo (reference ``python/mxnet/gluon/model_zoo/``).
+
+Provides the same constructor surface (``vision.resnet50_v1()`` etc.) built
+on the TPU-native Gluon layers.  Pretrained-weight download is descoped in
+this build (zero-egress environment); constructors accept ``pretrained``
+for API parity and raise with a clear message when it is requested.
+"""
+from . import vision  # noqa: F401
+
+__all__ = ["vision"]

@@ -1,0 +1,43 @@
+"""Model weight store (reference ``gluon/model_zoo/model_store.py``).
+
+The reference downloads pretrained ``.params`` files from a public bucket.
+This build runs with zero egress, so the store only resolves *local* files:
+set ``MXNET_HOME`` (default ``~/.mxnet``) and drop ``<name>.params`` under
+``models/`` to use pretrained weights.  ``get_model_file`` raises a clear
+error otherwise instead of attempting a download.
+"""
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def _model_dir(root):
+    return os.path.expanduser(root)
+
+
+def get_model_file(name, root=os.path.join(
+        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")), "models")):
+    """Return the local path of a pretrained parameter file.
+
+    Unlike the reference (which downloads on miss), a missing file is an
+    error: this environment has no network access.
+    """
+    root = _model_dir(root)
+    file_path = os.path.join(root, name + ".params")
+    if os.path.exists(file_path):
+        return file_path
+    raise FileNotFoundError(
+        "Pretrained weights for %r not found at %s. Download is not "
+        "available in this build; place the .params file there manually."
+        % (name, file_path))
+
+
+def purge(root=os.path.join(
+        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")), "models")):
+    """Remove cached parameter files (reference model_store.purge)."""
+    root = _model_dir(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -12,12 +12,118 @@ op per parameter (``optimizer.py _apply``).
 """
 from __future__ import annotations
 
+from .. import autograd
 from .. import kvstore as kvs
 from .. import optimizer as opt
 from ..base import MXNetError
+from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+
+class _FusedUpdate:
+    """Every parameter's optimizer update as ONE jitted XLA program.
+
+    The reference batches tiny per-weight update kernels with aggregated
+    multi-weight ops (``optimizer.py:46`` aggregate_num,
+    ``model.py:130-148`` ``_update_params_on_kvstore_nccl``,
+    ``MXNET_UPDATE_AGGREGATION_SIZE``) to amortize launch overhead.  Here
+    the whole update sweep — all weights, all optimizer states — compiles
+    into a single donated-buffer XLA call: one dispatch instead of
+    O(n_params), with the per-weight elementwise updates fused/scheduled by
+    XLA.  States live in the owning ``Updater`` (same objects), so
+    ``save_states``/``load_states`` serialize exactly what this path
+    updates.
+
+    Falls back (returns False) when the optimizer has no pure ``make_step``,
+    uses multi-precision master weights, or holds non-NDArray state — the
+    caller then runs the eager per-parameter loop.
+    """
+
+    def __init__(self, updater):
+        self._updater = updater
+        self._cache = {}
+        self._unavailable = False
+
+    def __getstate__(self):
+        # the jitted executables are not picklable (and are cheap to
+        # rebuild); Trainer state serialization reaches here via
+        # optimizer.param_dict → Parameter._trainer
+        state = self.__dict__.copy()
+        state["_cache"] = {}
+        return state
+
+    def __call__(self, indices, grads, weights):
+        if self._unavailable:
+            return False
+        import jax
+        import jax.numpy as jnp
+        optimizer = self._updater.optimizer
+        if optimizer.multi_precision:
+            return False
+        states = self._updater.states
+        for i, w in zip(indices, weights):
+            if i not in states:
+                states[i] = optimizer.create_state_multi_precision(i, w)
+                self._updater.states_synced[i] = True
+        is_nd = lambda x: isinstance(x, NDArray)  # noqa: E731
+        leaves_per = []
+        for i in indices:
+            lv, _ = jax.tree_util.tree_flatten(states[i], is_leaf=is_nd)
+            if any(not isinstance(l, NDArray) for l in lv):
+                self._unavailable = True
+                return False
+            leaves_per.append(lv)
+        # make_step closures bake every scalar hyperparameter except lr/t at
+        # trace time, so the cache key must cover them — scalar attrs
+        # (momentum/betas/eps/wd/...; counters excluded) plus the resolved
+        # per-index wds (covers wd_mult / param_dict mutation)
+        fingerprint = tuple(sorted(
+            (k, v) for k, v in vars(optimizer).items()
+            if isinstance(v, (int, float, bool, str, type(None)))
+            and k not in ("num_update", "begin_num_update")))
+        key = (tuple(indices), fingerprint,
+               tuple(optimizer._get_wds(list(indices))),
+               tuple((w.shape, str(w.dtype)) for w in weights))
+        jfn = self._cache.get(key)
+        if jfn is None:
+            try:
+                steps = [optimizer.make_step(i) for i in indices]
+            except NotImplementedError:
+                self._unavailable = True
+                return False
+
+            def fused(wvals, gvals, svals, t, lr_vec):
+                new_w, new_s = [], []
+                for k, step in enumerate(steps):
+                    res = step(wvals[k], gvals[k], t,
+                               lr_vec[k].astype(wvals[k].dtype), *svals[k])
+                    new_w.append(res[0])
+                    new_s.append(list(res[1:]))
+                return new_w, new_s
+
+            # donate weights + states: the update is in-place at the XLA
+            # level, matching the reference's kWriteInplace update ops
+            jfn = jax.jit(fused, donate_argnums=(0, 2))
+            self._cache[key] = jfn
+        # count the step only once the fused path is committed to running —
+        # the eager fallback does its own counting
+        optimizer._update_count(list(indices))
+        lrs = optimizer._get_lrs(list(indices))
+        wvals = [w._data for w in weights]
+        gvals = [g._data for g in grads]
+        svals = [[l._data for l in lv] for lv in leaves_per]
+        new_w, new_s = jfn(wvals, gvals, svals,
+                           jnp.asarray(optimizer.num_update, jnp.int32),
+                           jnp.asarray(lrs, jnp.float32))
+        with autograd.pause():
+            for w, nv in zip(weights, new_w):
+                w._data = nv
+            for lv, nlv in zip(leaves_per, new_s):
+                for l, nl in zip(lv, nlv):
+                    l._data = nl
+        return True
 
 
 class Trainer:
@@ -66,6 +172,8 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init = []
+        self._kv_fused = None
+        self._local_fused = None
         self._reset_kvstore()
 
     def _init_optimizer(self, optimizer, optimizer_params):
@@ -172,6 +280,8 @@ class Trainer:
         if self._params_to_init:
             self._init_params()
         if self._kvstore and self._update_on_kvstore:
+            if self._fused_on_kvstore():
+                return
             # push grads, pull updated weights (reference _update_params_on_kvstore)
             for i, param in enumerate(self._params):
                 if param.grad_req == "null":
@@ -183,6 +293,36 @@ class Trainer:
             return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _fused_on_kvstore(self):
+        """Run the whole update as one jitted program through the store's
+        updater when the store is in-process (local/device, or the tpu store
+        in a single process, where eager push's all-reduce is a
+        re-replication XLA performs anyway inside the fused program)."""
+        store = self._kvstore
+        if not isinstance(store, kvs.KVStoreLocal) or store._updater is None:
+            return False
+        if isinstance(store, kvs.KVStoreTPU):
+            import jax
+            if jax.process_count() > 1:
+                return False
+        if self._kv_fused is None or self._kv_fused._updater is not store._updater:
+            self._kv_fused = _FusedUpdate(store._updater)
+        indices, grads, weights = [], [], []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            indices.append(i)
+            grads.append(param.grad())
+            weights.append(param.data())
+        if not indices:
+            return True
+        ok = self._kv_fused(indices, grads, weights)
+        if ok:
+            # keep the store's pull view coherent with the updated weights
+            for i, w in zip(indices, weights):
+                store._store[i] = w
+        return ok
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._kvstore and self._kv_initialized:
@@ -212,10 +352,22 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        if self._local_fused is None or \
+                self._local_fused._updater is not self._updaters:
+            self._local_fused = _FusedUpdate(self._updaters)
+        indices, grads, weights = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            self._updaters(i, param.grad(), param.data())
+            indices.append(i)
+            grads.append(param.grad())
+            weights.append(param.data())
+        if not indices:
+            return
+        if self._local_fused(indices, grads, weights):
+            return
+        for i, g, w in zip(indices, grads, weights):
+            self._updaters(i, g, w)
 
     def save_states(self, fname):
         """(reference trainer.py:440)"""

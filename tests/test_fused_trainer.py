@@ -1,0 +1,105 @@
+"""Fused Trainer update path: one jitted program vs eager per-param loop."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _make_net(seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(0).rand(8, 10).astype("float32"))
+    net(x)  # materialize
+    return net, x
+
+
+def _train_steps(net, x, trainer, n=3):
+    loss_fn = gluon.loss.L2Loss()
+    y = mx.nd.array(onp.random.RandomState(1).rand(8, 4).astype("float32"))
+    for _ in range(n):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+    # global name counters differ between nets; compare by position
+    return [v.data().asnumpy()
+            for _, v in sorted(net.collect_params().items())]
+
+
+def test_fused_matches_eager_sgd():
+    net1, x1 = _make_net()
+    t1 = gluon.Trainer(net1.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    out_fused = _train_steps(net1, x1, t1)
+    assert t1._kv_fused is not None and not t1._kv_fused._unavailable
+
+    net2, x2 = _make_net()
+    t2 = gluon.Trainer(net2.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    t2._fused_on_kvstore = lambda: False  # force eager push/pull path
+    out_eager = _train_steps(net2, x2, t2)
+
+    for a, b in zip(out_fused, out_eager):
+        onp.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_matches_eager_adam():
+    net1, x1 = _make_net()
+    t1 = gluon.Trainer(net1.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    out_fused = _train_steps(net1, x1, t1)
+
+    net2, x2 = _make_net()
+    t2 = gluon.Trainer(net2.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    t2._fused_on_kvstore = lambda: False
+    out_eager = _train_steps(net2, x2, t2)
+
+    for a, b in zip(out_fused, out_eager):
+        onp.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_lr_schedule_advances():
+    """The scheduled lr must advance inside the fused (cached-jit) path."""
+    net, x = _make_net()
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5)
+    t = gluon.Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 1.0, "lr_scheduler": sched})
+    loss_fn = gluon.loss.L2Loss()
+    y = mx.nd.zeros((8, 4))
+    lrs = []
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        t.step(8)
+        lrs.append(t._optimizer.learning_rate)
+    assert lrs[0] > lrs[1] > lrs[2], lrs
+
+
+def test_fused_update_on_kvstore_false():
+    """update_on_kvstore=False exercises the Trainer-level fused updater."""
+    net, x = _make_net()
+    t = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      update_on_kvstore=False)
+    out = _train_steps(net, x, t)
+    assert t._local_fused is not None and not t._local_fused._unavailable
+    for v in out:
+        assert onp.isfinite(v).all()
+
+
+def test_fused_save_load_states_roundtrip():
+    import tempfile, os
+    net, x = _make_net()
+    t = gluon.Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    _train_steps(net, x, t, n=2)
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "trainer.states")
+        t.save_states(fname)
+        t.load_states(fname)
+    _train_steps(net, x, t, n=1)

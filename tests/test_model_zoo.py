@@ -1,0 +1,124 @@
+"""Model zoo tests (reference tests/python/unittest/test_gluon_model_zoo.py).
+
+Forward tests run hybridized (one XLA compile per net) on small batches;
+constructor coverage sweeps every registry name.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+ALL_MODELS = [
+    "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+    "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+    "resnet101_v2", "resnet152_v2",
+    "vgg11", "vgg13", "vgg16", "vgg19",
+    "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+    "alexnet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "squeezenet1.0", "squeezenet1.1", "inceptionv3",
+    "mobilenet1.0", "mobilenet0.75", "mobilenet0.5", "mobilenet0.25",
+    "mobilenetv2_1.0", "mobilenetv2_0.75", "mobilenetv2_0.5",
+    "mobilenetv2_0.25",
+]
+
+
+@pytest.mark.parametrize("name", ALL_MODELS)
+def test_constructors(name):
+    net = vision.get_model(name, classes=10)
+    params = net.collect_params()
+    assert len(params) > 0
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("no_such_model")
+
+
+def _forward(net, shape):
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(onp.random.uniform(size=shape).astype("float32"))
+    with mx.autograd.train_mode():
+        y = net(x)
+    out = y.asnumpy()
+    assert onp.isfinite(out).all()
+    return out
+
+
+def test_resnet18_v1_forward():
+    out = _forward(vision.resnet18_v1(classes=10), (2, 3, 64, 64))
+    assert out.shape == (2, 10)
+
+
+def test_resnet18_v2_forward():
+    out = _forward(vision.resnet18_v2(classes=10), (2, 3, 64, 64))
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_v1_forward():
+    out = _forward(vision.resnet50_v1(classes=10), (1, 3, 64, 64))
+    assert out.shape == (1, 10)
+
+
+def test_mobilenet_forward():
+    out = _forward(vision.mobilenet0_25(classes=10), (2, 3, 64, 64))
+    assert out.shape == (2, 10)
+
+
+def test_mobilenet_v2_forward():
+    out = _forward(vision.mobilenet_v2_0_25(classes=10), (2, 3, 64, 64))
+    assert out.shape == (2, 10)
+
+
+def test_squeezenet_forward():
+    out = _forward(vision.squeezenet1_1(classes=10), (2, 3, 224, 224))
+    assert out.shape == (2, 10)
+
+
+def test_densenet_forward():
+    out = _forward(vision.densenet121(classes=10), (1, 3, 224, 224))
+    assert out.shape == (1, 10)
+
+
+def test_vgg11_forward():
+    out = _forward(vision.vgg11(classes=10), (1, 3, 224, 224))
+    assert out.shape == (1, 10)
+
+
+def test_alexnet_forward():
+    out = _forward(vision.alexnet(classes=10), (2, 3, 224, 224))
+    assert out.shape == (2, 10)
+
+
+def test_inception_forward():
+    out = _forward(vision.inception_v3(classes=10), (1, 3, 299, 299))
+    assert out.shape == (1, 10)
+
+
+def test_resnet_train_eval_modes():
+    """BN running stats update in train mode and freeze in eval."""
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    x = mx.nd.array(onp.random.uniform(size=(2, 3, 32, 32)).astype("float32"))
+    net(x)  # materialize deferred shapes
+    rm_before = [p.data().asnumpy().copy()
+                 for n, p in net.collect_params().items()
+                 if "running_mean" in n]
+    with mx.autograd.train_mode():
+        net(x)
+    rm_after = [p.data().asnumpy()
+                for n, p in net.collect_params().items()
+                if "running_mean" in n]
+    changed = any(not onp.allclose(a, b)
+                  for a, b in zip(rm_before, rm_after))
+    assert changed
+    # eval mode: stats frozen
+    rm_before = [a.copy() for a in rm_after]
+    net(x)
+    rm_after = [p.data().asnumpy()
+                for n, p in net.collect_params().items()
+                if "running_mean" in n]
+    for a, b in zip(rm_before, rm_after):
+        onp.testing.assert_allclose(a, b)
