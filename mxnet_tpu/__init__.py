@@ -33,6 +33,7 @@ from . import recordio
 from . import image
 from . import gluon
 from . import parallel
+from . import profiler
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray
@@ -42,5 +43,5 @@ __all__ = [
     "current_context", "num_gpus", "num_tpus", "nd", "ndarray",
     "autograd", "random", "NDArray", "initializer", "init", "gluon",
     "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
-    "io", "recordio", "image", "parallel",
+    "io", "recordio", "image", "parallel", "profiler",
 ]

@@ -5,6 +5,7 @@ from . import utils  # noqa: F401
 from . import loss  # noqa: F401
 from . import data  # noqa: F401
 from . import model_zoo  # noqa: F401
+from . import rnn  # noqa: F401
 from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .parameter import (  # noqa: F401
     Constant, DeferredInitializationError, Parameter, ParameterDict)
@@ -12,7 +13,7 @@ from .trainer import Trainer  # noqa: F401
 
 from .utils import split_and_load, split_data  # noqa: F401
 
-__all__ = ["nn", "utils", "loss", "data", "model_zoo",
+__all__ = ["nn", "utils", "loss", "data", "model_zoo", "rnn",
            "Block", "HybridBlock", "SymbolBlock",
            "Parameter", "Constant", "ParameterDict",
            "DeferredInitializationError", "Trainer",

@@ -12,11 +12,15 @@ __all__ = ["get_model_file", "purge"]
 
 
 def _model_dir(root):
+    if root is None:
+        # resolve MXNET_HOME at call time so users can set it after import
+        root = os.path.join(
+            os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")),
+            "models")
     return os.path.expanduser(root)
 
 
-def get_model_file(name, root=os.path.join(
-        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")), "models")):
+def get_model_file(name, root=None):
     """Return the local path of a pretrained parameter file.
 
     Unlike the reference (which downloads on miss), a missing file is an
@@ -32,8 +36,7 @@ def get_model_file(name, root=os.path.join(
         % (name, file_path))
 
 
-def purge(root=os.path.join(
-        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")), "models")):
+def purge(root=None):
     """Remove cached parameter files (reference model_store.purge)."""
     root = _model_dir(root)
     if not os.path.isdir(root):

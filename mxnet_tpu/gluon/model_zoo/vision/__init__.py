@@ -11,14 +11,6 @@ from .resnet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
 from .vgg import *  # noqa: F401,F403
 
-from . import alexnet as _alexnet
-from . import densenet as _densenet
-from . import inception as _inception
-from . import mobilenet as _mobilenet
-from . import resnet as _resnet
-from . import squeezenet as _squeezenet
-from . import vgg as _vgg
-
 
 def get_model(name, **kwargs):
     """Return a model by name (reference vision/__init__.py:91)."""

@@ -27,6 +27,9 @@ from ..ndarray import ndarray as _nd_mod
 
 __all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
 
+# toggled by gluon.utils.materialize_params while tracing abstractly
+_ABSTRACT_INIT = [False]
+
 
 class DeferredInitializationError(MXNetError):
     """Raised when accessing a parameter whose shape is not yet known
@@ -135,8 +138,29 @@ class Parameter:
 
     def _init_impl(self, init, ctx, default_init):
         self._deferred_init = ()
-        data = zeros(self._shape, ctx=ctx, dtype=self.dtype)
-        with autograd.pause():
+        import jax
+        from ..context import Context, cpu as _cpu_ctx
+        if _ABSTRACT_INIT[0]:
+            # shape-inference trace (gluon.utils.materialize_params): give
+            # the trace a placeholder; the real host-side initialization
+            # runs after the trace completes
+            import jax.numpy as jnp
+            from ..ndarray.ndarray import _wrap
+            self._data = _wrap(
+                jnp.zeros(self._shape, onp.dtype(self.dtype)), _cpu_ctx())
+            return
+        # generate on the host (fast local kernel compiles — on an
+        # accelerator backend every per-shape init op would compile over
+        # the device link), then place with ONE transfer; jax RNG is
+        # backend-independent so values are identical either way
+        host = _cpu_ctx()
+        from ..ndarray.ndarray import _wrap
+        import jax.numpy as jnp
+        with autograd.pause(), jax.default_device(host.jax_device):
+            # host-numpy buffer → one transfer; avoids an XLA fill compile
+            # per parameter shape
+            data = _wrap(jnp.asarray(
+                onp.zeros(self._shape, dtype=onp.dtype(self.dtype))), host)
             desc = initializer.InitDesc(self.name)
             if init is not None:
                 # param-specific init bypasses the name-suffix dispatch
@@ -148,13 +172,24 @@ class Parameter:
                     fn(desc, data)
             else:
                 initializer.create(default_init)(desc, data)
-            data._data = data._data.astype(onp.dtype(self.dtype))
+            if data._data.dtype != onp.dtype(self.dtype):
+                data._data = jnp.asarray(
+                    onp.asarray(data._data).astype(self.dtype))
+        if ctx is not None and Context(ctx) != host:
+            data = data.as_in_context(Context(ctx))
         self._data = data
         if self._grad_req != "null":
             self._init_grad()
 
     def _init_grad(self):
-        self._grad = zeros(self._shape, ctx=self._data.ctx, dtype=self.dtype)
+        from ..ndarray.ndarray import _wrap
+        import jax
+        import jax.numpy as jnp
+        buf = jnp.asarray(onp.zeros(self._shape, dtype=onp.dtype(self.dtype)))
+        ctx = self._data.ctx
+        if ctx.device_type not in ("cpu", "cpu_pinned", "cpu_shared"):
+            buf = jax.device_put(buf, ctx.jax_device)
+        self._grad = _wrap(buf, ctx)
         autograd.mark_variables([self._data], [self._grad], self._grad_req)
 
     def _finish_deferred_init(self, shape):

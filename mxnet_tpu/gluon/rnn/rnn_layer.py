@@ -1,0 +1,258 @@
+"""Fused recurrent layers RNN / LSTM / GRU
+(reference ``python/mxnet/gluon/rnn/rnn_layer.py``).
+
+Each layer owns per-(layer, direction) parameters with the reference's
+names (``l0_i2h_weight``, ``r0_h2h_bias`` …) and concatenates them into the
+fused RNN op's flat vector per forward (the reference's
+``_rnn_param_concat``, rnn_layer.py:273).  The op lowers to ``lax.scan``
+with hoisted input projections (ops/rnn.py) — the TPU analogue of the
+cuDNN RNN descriptor path (``src/operator/rnn.cu``); BASELINE config 4.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from . import rnn_cell
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    """Base fused layer (reference rnn_layer.py _RNNLayer)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        # parameter names match the reference so checkpoints line up
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(
+                    "%s%d_i2h_weight" % (j, i), (ng * nh, ni),
+                    i2h_weight_initializer)
+                self._register_param(
+                    "%s%d_h2h_weight" % (j, i), (ng * nh, nh),
+                    h2h_weight_initializer)
+                self._register_param(
+                    "%s%d_i2h_bias" % (j, i), (ng * nh,),
+                    i2h_bias_initializer)
+                self._register_param(
+                    "%s%d_h2h_bias" % (j, i), (ng * nh,),
+                    h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def infer_shape(self, x, *args):
+        # input size from the trailing dim of the (layout-ordered) input
+        ni = x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, "%s%d_i2h_weight" % (j, i)) \
+                    ._finish_deferred_init((ng * nh, ni))
+                getattr(self, "%s%d_h2h_weight" % (j, i)) \
+                    ._finish_deferred_init((ng * nh, nh))
+                getattr(self, "%s%d_i2h_bias" % (j, i)) \
+                    ._finish_deferred_init((ng * nh,))
+                getattr(self, "%s%d_h2h_bias" % (j, i)) \
+                    ._finish_deferred_init((ng * nh,))
+            ni = nh * self._dir
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "%s -> %s" % (shape[1] if shape[1] else None,
+                                shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            info.update(kwargs)
+            info.pop("__layout__", None)
+            states.append(func(**info))
+        return states
+
+    def _unfuse(self):
+        """Expand into a SequentialRNNCell of per-layer cells (reference
+        rnn_layer.py:145)."""
+        get_cell = {
+            "rnn_relu": lambda **kw: rnn_cell.RNNCell(
+                self._hidden_size, activation="relu", **kw),
+            "rnn_tanh": lambda **kw: rnn_cell.RNNCell(
+                self._hidden_size, activation="tanh", **kw),
+            "lstm": lambda **kw: rnn_cell.LSTMCell(self._hidden_size, **kw),
+            "gru": lambda **kw: rnn_cell.GRUCell(self._hidden_size, **kw),
+        }[self._mode]
+        stack = rnn_cell.HybridSequentialRNNCell(prefix=self.prefix,
+                                                 params=self.params)
+        with stack.name_scope():
+            ni = self._input_size
+            for i in range(self._num_layers):
+                kwargs = {
+                    "input_size": ni,
+                    "i2h_weight_initializer": self._i2h_weight_initializer,
+                    "h2h_weight_initializer": self._h2h_weight_initializer,
+                    "i2h_bias_initializer": self._i2h_bias_initializer,
+                    "h2h_bias_initializer": self._h2h_bias_initializer,
+                }
+                if self._dir == 2:
+                    stack.add(rnn_cell.BidirectionalCell(
+                        get_cell(prefix="l%d_" % i, **kwargs),
+                        get_cell(prefix="r%d_" % i, **kwargs)))
+                else:
+                    stack.add(get_cell(prefix="l%d_" % i, **kwargs))
+                if self._dropout > 0 and i != self._num_layers - 1:
+                    stack.add(rnn_cell.DropoutCell(self._dropout))
+                ni = self._hidden_size * self._dir
+        return stack
+
+    def forward(self, inputs, states=None):
+        """(reference rnn_layer.py forward_kernel) — accepts optional
+        states; returns output or (output, states)."""
+        from ... import ndarray as nd
+        batch_axis = self._layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.context,
+                                      dtype=inputs.dtype)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        for state, info in zip(states, self.state_info(batch_size)):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    "Invalid recurrent state shape. Expecting %s, got %s." % (
+                        str(info["shape"]), str(state.shape)))
+        out = self._forward_kernel(inputs, states)
+        return out[0] if skip_states else out
+
+    def _flat_params(self):
+        from ... import ndarray as nd
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(getattr(self, "%s%d_i2h_weight" % (j, i))
+                          .data().reshape(-1))
+                ws.append(getattr(self, "%s%d_h2h_weight" % (j, i))
+                          .data().reshape(-1))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(getattr(self, "%s%d_i2h_bias" % (j, i)).data())
+                bs.append(getattr(self, "%s%d_h2h_bias" % (j, i)).data())
+        return nd.concat(*(ws + bs), dim=0)
+
+    def _forward_kernel(self, inputs, states):
+        from ... import ndarray as nd
+        if self._layout == "NTC":
+            inputs = nd.swapaxes(inputs, dim1=0, dim2=1)
+        # deferred-init completion before reading .data()
+        if any(p._data is None for p in self.collect_params().values()):
+            self.infer_shape(inputs)
+        params = self._flat_params()
+        if self._mode == "lstm":
+            rnn_args = [states[0], states[1]]
+        else:
+            rnn_args = [states[0]]
+        out, h, c = nd.RNN(
+            inputs, params, *rnn_args, state_size=self._hidden_size,
+            num_layers=self._num_layers, bidirectional=self._dir == 2,
+            p=self._dropout, state_outputs=True, mode=self._mode)
+        if self._layout == "NTC":
+            out = nd.swapaxes(out, dim1=0, dim2=1)
+        states_out = [h, c] if self._mode == "lstm" else [h]
+        return out, states_out
+
+
+class RNN(_RNNLayer):
+    r"""Multi-layer Elman RNN, relu or tanh (reference rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    r"""Multi-layer LSTM (reference rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    r"""Multi-layer GRU (reference rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

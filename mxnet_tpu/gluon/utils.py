@@ -121,3 +121,71 @@ def _indent(s_, num_spaces):
         return s_
     first = lines.pop(0)
     return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
+
+
+def materialize_params(net, *inputs):
+    """Complete all deferred parameter shapes WITHOUT executing the network.
+
+    Runs one forward under ``jax.eval_shape`` (abstract tracing): layer
+    ``infer_shape`` rules fire off static tracer shapes and initializers run
+    eagerly per parameter, but no network kernel is compiled or executed —
+    the cheap analogue of the reference's symbolic shape inference pass
+    (``infer_graph_attr_pass.cc``), where MXNet never needs a warm-up
+    forward.  ``inputs`` are NDArrays (or ShapeDtypeStruct-likes) giving the
+    input signature.
+    """
+    import jax
+
+    from .. import autograd
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap
+
+    specs = []
+    for a in inputs:
+        if isinstance(a, NDArray):
+            specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+        else:
+            specs.append(jax.ShapeDtypeStruct(tuple(a.shape), a.dtype))
+
+    def run(*vals):
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(False)
+        try:
+            out = net.forward(*[_wrap(v) for v in vals])
+        finally:
+            autograd.set_recording(prev_rec)
+            autograd.set_training(prev_train)
+        out_list = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._data for o in out_list)
+
+    # parameters initialized *inside* the abstract trace come out as
+    # tracers (device_put stages under an ambient trace) — snapshot the
+    # deferred-init configs, let the trace discover the shapes, then redo
+    # those initializations for real outside the trace
+    params = list(net.collect_params().values())
+    deferred = {id(p): (p, p._deferred_init) for p in params
+                if p._deferred_init}
+    # the global RNG key advances (to a tracer!) when initializers run
+    # under the trace — snapshot and restore so the real inits below get a
+    # clean concrete key stream
+    from .. import random as _random
+    from .parameter import _ABSTRACT_INIT
+    saved_key = _random._STATE.key
+    _ABSTRACT_INIT[0] = True
+    try:
+        out = jax.eval_shape(run, *specs)
+    finally:
+        _ABSTRACT_INIT[0] = False
+        _random._STATE.key = saved_key
+        # even on a failed trace, never leave tracer placeholders behind:
+        # restore the deferred state (and redo for real where the shape was
+        # discovered) so the parameter remains usable
+        import jax.core as jcore
+        for p, dinit in deferred.values():
+            if p._data is not None and isinstance(p._data._data, jcore.Tracer):
+                p._deferred_init = dinit
+                p._data = None
+                p._grad = None
+                if p.shape is not None and all(s > 0 for s in p.shape):
+                    p._finish_deferred_init(p.shape)
+    return out

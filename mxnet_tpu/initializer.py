@@ -2,9 +2,14 @@
 
 Reference: ``python/mxnet/initializer.py`` (~800 LoC): registry of named
 initializers applied by name-pattern matching (arrays named ``*_weight`` get
-the default init, ``*_bias``/``*_gamma``... get specialized ones).  TPU-native
-detail: initialization itself runs as jitted XLA code on-device via
-``jax.random`` (stateless keys from ``mxnet_tpu.random``), not host numpy.
+the default init, ``*_bias``/``*_gamma``... get specialized ones).
+
+TPU-native detail: random draws happen on the HOST with a numpy generator
+seeded from the ``mx.random`` key stream — determinism under
+``mx.random.seed`` is preserved, and a ResNet-scale init is a single
+device transfer per parameter instead of a per-shape XLA compile per draw
+(initialization is one-shot host work; jitted on-device RNG only pays off
+inside the training step, where dropout etc. do use ``jax.random``).
 """
 from __future__ import annotations
 
@@ -33,6 +38,15 @@ def register(klass):
     initializer.py ``@register`` / ``mx.init.registry``)."""
     _INIT_REGISTRY[klass.__name__.lower()] = klass
     return klass
+
+
+def _host_rng() -> onp.random.Generator:
+    """Numpy generator seeded from the mx.random key stream — one
+    fixed-shape device op per draw (cached executable) instead of a
+    per-shape compile."""
+    k = _random.next_key()
+    seed = onp.asarray(jax.random.key_data(k)).ravel().astype(onp.uint64)
+    return onp.random.Generator(onp.random.Philox(key=seed))
 
 
 class InitDesc(str):
@@ -93,16 +107,17 @@ class Initializer:
         else:
             self._init_default(desc, arr)
 
-    # -- fill helpers (rebind the NDArray's buffer with a jitted fill) ------
+    # -- fill helpers (host-side fill, one transfer per parameter) ----------
     @staticmethod
     def _set(arr, value):
-        arr._data = jnp.asarray(value, dtype=arr.dtype).reshape(arr.shape)
+        value = onp.asarray(value, dtype=onp.dtype(arr.dtype)).reshape(arr.shape)
+        arr._data = jnp.asarray(value)
 
     def _init_zero(self, name, arr):
-        self._set(arr, jnp.zeros(arr.shape, arr.dtype))
+        self._set(arr, onp.zeros(arr.shape))
 
     def _init_one(self, name, arr):
-        self._set(arr, jnp.ones(arr.shape, arr.dtype))
+        self._set(arr, onp.ones(arr.shape))
 
     def _init_bias(self, name, arr):
         self._init_zero(name, arr)
@@ -151,7 +166,7 @@ class Constant(Initializer):
         v = self.value
         if hasattr(v, "asnumpy"):
             v = v.asnumpy()
-        self._set(arr, jnp.broadcast_to(jnp.asarray(v, dtype=arr.dtype), arr.shape))
+        self._set(arr, onp.broadcast_to(onp.asarray(v), arr.shape))
 
 
 @register
@@ -163,9 +178,9 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        k = _random.next_key()
-        self._set(arr, jax.random.uniform(
-            k, arr.shape, jnp.float32, -self.scale, self.scale))
+        rng = _host_rng()
+        self._set(arr, rng.uniform(-self.scale, self.scale,
+                                   arr.shape).astype(onp.float32))
 
 
 @register
@@ -177,8 +192,9 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        k = _random.next_key()
-        self._set(arr, self.sigma * jax.random.normal(k, arr.shape, jnp.float32))
+        rng = _host_rng()
+        self._set(arr, rng.normal(0.0, self.sigma,
+                                  arr.shape).astype(onp.float32))
 
 
 @register
@@ -193,12 +209,12 @@ class Orthogonal(Initializer):
     def _init_weight(self, name, arr):
         nout = arr.shape[0]
         nin = int(onp.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
-        k = _random.next_key()
+        rng = _host_rng()
         if self.rand_type == "uniform":
-            tmp = jax.random.uniform(k, (nout, nin), jnp.float32, -1.0, 1.0)
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin)).astype(onp.float32)
         else:
-            tmp = jax.random.normal(k, (nout, nin), jnp.float32)
-        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+            tmp = rng.normal(0.0, 1.0, (nout, nin)).astype(onp.float32)
+        u, _, v = onp.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == (nout, nin) else v
         self._set(arr, self.scale * q.reshape(arr.shape))
 
@@ -233,11 +249,13 @@ class Xavier(Initializer):
         else:
             raise ValueError("Incorrect factor type")
         scale = math.sqrt(self.magnitude / factor)
-        k = _random.next_key()
+        rng = _host_rng()
         if self.rnd_type == "uniform":
-            self._set(arr, jax.random.uniform(k, shape, jnp.float32, -scale, scale))
+            self._set(arr, rng.uniform(-scale, scale,
+                                       shape).astype(onp.float32))
         elif self.rnd_type == "gaussian":
-            self._set(arr, scale * jax.random.normal(k, shape, jnp.float32))
+            self._set(arr, (scale * rng.normal(0.0, 1.0, shape))
+                      .astype(onp.float32))
         else:
             raise ValueError("Unknown random type")
 

@@ -14,6 +14,7 @@ from .ndarray import (  # noqa: F401
     _wrap,
 )
 from .utils import save, load  # noqa: F401
+from . import contrib  # noqa: F401
 
 _FUNC_CACHE = {}
 

@@ -1,0 +1,30 @@
+"""``mx.nd.contrib`` namespace (reference ``python/mxnet/ndarray/contrib.py``).
+
+Control-flow operators plus contrib helpers.
+"""
+from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def isfinite(data):
+    """Reference contrib.isfinite."""
+    from . import __getattr__ as _get
+    import jax.numpy as jnp
+    from .ndarray import invoke_fn
+    return invoke_fn(lambda x: jnp.isfinite(x).astype("float32"), [data],
+                     name="isfinite", record=False)
+
+
+def isnan(data):
+    from .ndarray import invoke_fn
+    import jax.numpy as jnp
+    return invoke_fn(lambda x: jnp.isnan(x).astype("float32"), [data],
+                     name="isnan", record=False)
+
+
+def isinf(data):
+    from .ndarray import invoke_fn
+    import jax.numpy as jnp
+    return invoke_fn(lambda x: jnp.isinf(x).astype("float32"), [data],
+                     name="isinf", record=False)

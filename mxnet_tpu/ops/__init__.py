@@ -10,5 +10,7 @@ from . import reduce        # noqa: F401
 from . import matrix        # noqa: F401
 from . import nn            # noqa: F401
 from . import random_ops    # noqa: F401
+from . import rnn           # noqa: F401
+from . import control_flow  # noqa: F401
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]

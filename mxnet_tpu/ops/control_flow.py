@@ -1,0 +1,316 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: ``src/operator/control_flow.cc`` — ``_foreach`` (:1089),
+``_while_loop`` (:1150), ``_cond`` (:1211), subgraph ops executing child
+CachedOps per iteration; Python surface ``mx.nd.contrib.foreach/while_loop/
+cond`` (``python/mxnet/ndarray/contrib.py``).
+
+TPU-native: the natural ``lax.scan`` / ``lax.cond`` fit.  ``while_loop``
+lowers to a masked ``lax.scan`` over ``max_iterations`` rather than
+``lax.while_loop`` so reverse-mode autodiff works (XLA cannot
+reverse-differentiate an unbounded loop; the reference builds an explicit
+backward subgraph instead — same bounded-unroll idea).  The whole loop is
+recorded as ONE tape node, so ``backward()`` runs XLA's fused scan
+transpose.
+
+These take Python callables operating on NDArrays, so they live outside
+the array-only op registry; ``mx.nd.contrib`` re-exports them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _flatten(x):
+    """Flatten a (possibly nested) list/tuple of NDArrays; return (leaves,
+    treedef-rebuilder)."""
+    from ..ndarray import NDArray
+    leaves = []
+
+    def conv(a):
+        if isinstance(a, NDArray):
+            leaves.append(a)
+            return ("leaf", len(leaves) - 1)
+        if isinstance(a, (list, tuple)):
+            return ("seq", [conv(i) for i in a], isinstance(a, tuple))
+        raise TypeError("control-flow inputs must be NDArrays or nested "
+                        "lists/tuples of NDArrays, got %s" % type(a))
+
+    tree = conv(x)
+
+    def rebuild(tree, vals):
+        tag = tree[0]
+        if tag == "leaf":
+            return vals[tree[1]]
+        items = [rebuild(t, vals) for t in tree[1]]
+        return tuple(items) if tree[2] else items
+
+    return leaves, tree, rebuild
+
+
+def _functional(callable_, n_results=None):
+    """Wrap an NDArray-level callable so it can run on traced jnp values
+    (recording off — the outer invoke_fn records the loop as one node)."""
+    from .. import autograd
+    from ..ndarray.ndarray import _wrap
+
+    def run(*tree_args):
+        prev = autograd.set_recording(False)
+        try:
+            wrapped = [jax.tree_util.tree_map(_wrap, a) for a in tree_args]
+            return callable_(*wrapped)
+        finally:
+            autograd.set_recording(prev)
+
+    return run
+
+
+def _vals(tree):
+    from ..ndarray import NDArray
+    return jax.tree_util.tree_map(
+        lambda a: a._data if isinstance(a, NDArray) else a, tree)
+
+
+def foreach(body, data, init_states):
+    """``lax.scan`` over axis 0 of ``data`` (reference _foreach,
+    control_flow.cc:1089; contrib.foreach semantics).
+
+    body(data_t, states) -> (outputs, new_states).  Returns
+    (stacked outputs, final states), each matching body's structure.
+
+    Under ``autograd.record()`` the loop executes imperatively step by step
+    (each op on the tape, so closures over external parameters
+    differentiate — the reference likewise runs the subgraph CachedOp per
+    iteration and cuts free variables as extra inputs); otherwise — eager
+    inference or inside a hybridize/jit trace — it lowers to one
+    ``lax.scan``.
+    """
+    from .. import autograd
+    from ..ndarray.ndarray import _wrap, invoke_fn
+
+    if autograd.is_recording():
+        return _foreach_imperative(body, data, init_states)
+
+    data_leaves, data_tree, rebuild_d = _flatten(data)
+    state_leaves, state_tree, rebuild_s = _flatten(init_states)
+    nd_, ns = len(data_leaves), len(state_leaves)
+    meta = {}
+
+    def fn(*vals):
+        dvals = vals[:nd_]
+        svals = vals[nd_:]
+
+        def scan_body(carry, xs):
+            from ..ndarray import NDArray
+            prev = autograd.set_recording(False)
+            try:
+                d_nd = rebuild_d(data_tree, [_wrap(v) for v in xs])
+                s_nd = rebuild_s(state_tree, [_wrap(v) for v in carry])
+                out, new_states = body(d_nd, s_nd)
+            finally:
+                autograd.set_recording(prev)
+            out_leaves, out_tree, _ = _flatten(out)
+            ns_leaves, ns_tree, _ = _flatten(new_states)
+            meta["out_tree"] = out_tree
+            meta["ns_tree"] = ns_tree
+            return (tuple(l._data for l in ns_leaves),
+                    tuple(l._data for l in out_leaves))
+
+        carry, ys = lax.scan(scan_body, tuple(svals), tuple(dvals))
+        meta["n_out"] = len(ys)
+        return tuple(ys) + tuple(carry)
+
+    outs = invoke_fn(fn, data_leaves + state_leaves, name="_foreach")
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    n_out = meta["n_out"]
+    _, _, rebuild_o = _flatten_template(meta["out_tree"])
+    outputs = rebuild_o(meta["out_tree"], list(outs[:n_out]))
+    _, _, rebuild_ns = _flatten_template(meta["ns_tree"])
+    states = rebuild_ns(meta["ns_tree"], list(outs[n_out:]))
+    return outputs, states
+
+
+def _flatten_template(tree):
+    """Rebuilder for a pre-computed tree structure."""
+    def rebuild(tree, vals):
+        tag = tree[0]
+        if tag == "leaf":
+            return vals[tree[1]]
+        items = [rebuild(t, vals) for t in tree[1]]
+        return tuple(items) if tree[2] else items
+    return None, tree, rebuild
+
+
+def _stack_time(rows):
+    """Stack per-step NDArray results along a new axis 0 (tape-recorded)."""
+    from ..ndarray import stack as nd_stack
+    return nd_stack(*rows, axis=0)
+
+
+def _foreach_imperative(body, data, init_states):
+    from ..ndarray import NDArray
+
+    data_leaves, data_tree, rebuild_d = _flatten(data)
+    T = data_leaves[0].shape[0]
+    states = init_states
+    out_rows = []
+    for t in range(T):
+        d_t = rebuild_d(data_tree, [l[t] for l in data_leaves])
+        out, states = body(d_t, states)
+        out_rows.append(out)
+    out_leaves0, out_tree, _ = _flatten(out_rows[0])
+    stacked = []
+    for i in range(len(out_leaves0)):
+        stacked.append(_stack_time([_flatten(r)[0][i] for r in out_rows]))
+    _, _, rebuild_o = _flatten_template(out_tree)
+    return rebuild_o(out_tree, stacked), states
+
+
+def _while_loop_imperative(cond, func, loop_vars, max_iterations):
+    from ..ndarray import NDArray
+
+    var_leaves, var_tree, rebuild_v = _flatten(loop_vars)
+    v = rebuild_v(var_tree, var_leaves)
+    v_list = list(v) if isinstance(v, (list, tuple)) else [v]
+    out_rows = []
+    for _ in range(max_iterations):
+        pred = cond(*v_list)
+        pval = pred.asnumpy() if isinstance(pred, NDArray) else pred
+        if not bool(onp_any(pval)):
+            break
+        out, new_vars = func(*v_list)
+        out_rows.append(out)
+        v_list = list(new_vars) if isinstance(new_vars, (list, tuple)) \
+            else [new_vars]
+    if out_rows:
+        out_leaves0, out_tree, _ = _flatten(out_rows[0])
+        stacked = [_stack_time([_flatten(r)[0][i] for r in out_rows])
+                   for i in range(len(out_leaves0))]
+        _, _, rebuild_o = _flatten_template(out_tree)
+        outputs = rebuild_o(out_tree, stacked)
+    else:
+        outputs = None
+    finals = v_list if len(v_list) > 1 else v_list[0]
+    return outputs, finals
+
+
+def onp_any(x):
+    import numpy as onp
+    return onp.any(x)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Bounded while loop (reference _while_loop, control_flow.cc:1150;
+    contrib.while_loop semantics).
+
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) ->
+    (step_output, new_loop_vars).  Runs at most ``max_iterations``; lowers
+    to a masked scan so gradients flow.  Returns (outputs, final vars);
+    eager calls trim outputs to the realized step count, traced calls
+    return the padded ``max_iterations`` buffer (XLA static shapes).
+    """
+    from .. import autograd
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap, invoke_fn
+
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (bounded loops "
+                         "are what XLA can compile and differentiate)")
+    if autograd.is_recording():
+        return _while_loop_imperative(cond, func, loop_vars, max_iterations)
+    var_leaves, var_tree, rebuild_v = _flatten(loop_vars)
+    meta = {}
+
+    def fn(*vals):
+        def scan_body(carry, _):
+            active, vvals = carry
+            prev = autograd.set_recording(False)
+            try:
+                v_nd = rebuild_v(var_tree, [_wrap(v) for v in vvals])
+                v_list = v_nd if isinstance(v_nd, (list, tuple)) else [v_nd]
+                pred = cond(*v_list)
+                pred_val = pred._data if isinstance(pred, NDArray) else pred
+                pred_val = jnp.reshape(pred_val, ()).astype(bool)
+                step = active & pred_val
+                out, new_vars = func(*v_list)
+            finally:
+                autograd.set_recording(prev)
+            out_leaves, out_tree, _ = _flatten(out)
+            nv_leaves, _, _ = _flatten(new_vars)
+            meta["out_tree"] = out_tree
+            new_vvals = tuple(
+                jnp.where(step, nl._data, ov)
+                for nl, ov in zip(nv_leaves, vvals))
+            outs = tuple(jnp.where(step, ol._data, jnp.zeros_like(ol._data))
+                         for ol in out_leaves)
+            return (step, new_vvals), (outs, step)
+
+        init = (jnp.asarray(True), tuple(vals))
+        (final_active, final_vals), (ys, steps) = lax.scan(
+            scan_body, init, None, length=max_iterations)
+        meta["n_out"] = len(ys)
+        # float32 so the tape's vjp gets a float cotangent slot
+        return tuple(ys) + (jnp.sum(steps.astype(jnp.float32)),) + \
+            tuple(final_vals)
+
+    outs = invoke_fn(fn, var_leaves, name="_while_loop")
+    n_out = meta["n_out"]
+    out_nd = list(outs[:n_out])
+    n_steps = outs[n_out]
+    final_nd = list(outs[n_out + 1:])
+    import jax.core as jcore
+    if not isinstance(n_steps._data, jcore.Tracer):
+        k = int(n_steps.asnumpy())
+        out_nd = [o[:max(k, 1)] for o in out_nd]
+    _, _, rebuild_o = _flatten_template(meta["out_tree"])
+    outputs = rebuild_o(meta["out_tree"], out_nd)
+    finals = rebuild_v(var_tree, final_nd)
+    return outputs, finals
+
+
+def cond(pred, then_func, else_func):
+    """Two-branch conditional (reference _cond, control_flow.cc:1211;
+    contrib.cond semantics).  Both branches trace; XLA executes one."""
+    from .. import autograd
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap, invoke_fn
+
+    if not isinstance(pred, NDArray):
+        # python-scalar predicate: no tracing needed, run the taken branch
+        return then_func() if pred else else_func()
+    import jax.core as jcore
+    if autograd.is_recording() and not isinstance(pred._data, jcore.Tracer):
+        # imperative mode: evaluate the predicate, run the taken branch on
+        # the tape (closures differentiate; reference runs the chosen
+        # subgraph CachedOp)
+        import numpy as onp
+        return then_func() if bool(onp.any(pred.asnumpy())) else else_func()
+    meta = {}
+
+    def make_branch(f):
+        def branch(_):
+            prev = autograd.set_recording(False)
+            try:
+                out = f()
+            finally:
+                autograd.set_recording(prev)
+            leaves, tree, _ = _flatten(out)
+            meta["tree"] = tree
+            return tuple(l._data for l in leaves)
+        return branch
+
+    def fn(pval):
+        p = jnp.reshape(pval, ()).astype(bool)
+        return lax.cond(p, make_branch(then_func), make_branch(else_func),
+                        operand=None)
+
+    outs = invoke_fn(fn, [pred], name="_cond")
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    _, _, rebuild = _flatten_template(meta["tree"])
+    return rebuild(meta["tree"], list(outs))

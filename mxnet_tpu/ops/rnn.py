@@ -1,0 +1,185 @@
+"""Fused recurrent ops: vanilla RNN / LSTM / GRU over ``lax.scan``.
+
+Reference: ``src/operator/rnn-inl.h:62-68`` (modes kRnnRelu/kRnnTanh/kLstm/
+kGru) + ``rnn_impl.h`` native kernels and the cuDNN descriptor path
+(``rnn.cu``).  The reference keeps every layer's weights in ONE flat
+parameter vector (cuDNN layout); Gluon packs/unpacks it
+(``rnn_layer.py:273`` ``_rnn_param_concat``).  The same flat-vector contract
+is kept here.
+
+TPU-native design: the input-to-hidden projection for a whole sequence is
+hoisted OUT of the recurrence as one big ``(T*N, input) x (input, G*H)``
+matmul (MXU-dense), and ``lax.scan`` carries only the hidden-to-hidden
+step — the standard XLA RNN recipe, playing the role of cuDNN's fused RNN
+kernels.  Gate orders match Gluon's cells: LSTM [i, f, g, o], GRU [r, z, n].
+
+Per-direction parameter layout in the flat vector (layer-major, direction-
+minor, weights first then biases — the cuDNN/MXNet convention):
+    W_i2h (G*H, in), W_h2h (G*H, H)  for each (layer, dir), then
+    b_i2h (G*H,),   b_h2h (G*H,)    for each (layer, dir).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers: int, input_size: int, state_size: int,
+                   bidirectional: bool, mode: str,
+                   projection_size=None) -> int:
+    """Total flat-parameter length (reference rnn-inl.h GetRnnParamSize)."""
+    assert projection_size is None, "projection not supported"
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        size += dirs * (g * state_size * (in_sz + state_size)  # weights
+                        + 2 * g * state_size)                  # biases
+    return size
+
+
+def _split_params(params, num_layers, input_size, state_size, bidirectional,
+                  mode):
+    """Slice the flat vector into per-(layer, dir) weight/bias arrays."""
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    h = state_size
+    weights = []  # [(W_i2h, W_h2h), ...] layer-major, dir-minor
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * dirs
+        for _ in range(dirs):
+            w_i2h = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            w_h2h = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            weights.append((w_i2h, w_h2h))
+    biases = []
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            b_i2h = params[off:off + g * h]
+            off += g * h
+            b_h2h = params[off:off + g * h]
+            off += g * h
+            biases.append((b_i2h, b_h2h))
+    return weights, biases
+
+
+def _cell_step(mode, h):
+    """Return scan body: (carry, xproj_t) -> (carry', out_t).
+
+    ``xproj_t`` is the precomputed x_t @ W_i2h^T + b (hoisted matmul)."""
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(carry, xp, w_h2h, b_h2h):
+            (hs,) = carry
+            nh = act(xp + hs @ w_h2h.T + b_h2h)
+            return (nh,), nh
+        return step
+    if mode == "lstm":
+        def step(carry, xp, w_h2h, b_h2h):
+            hs, cs = carry
+            gates = xp + hs @ w_h2h.T + b_h2h
+            i, f, gg, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            gg = jnp.tanh(gg)
+            o = jax.nn.sigmoid(o)
+            nc = f * cs + i * gg
+            nh = o * jnp.tanh(nc)
+            return (nh, nc), nh
+        return step
+    if mode == "gru":
+        def step(carry, xrzn, w_h2h, b_h2h):
+            # GRU's candidate gate applies r BEFORE the h2h matmul, so the
+            # h2h projection cannot be folded into one matmul with i2h
+            (hs,) = carry
+            hproj = hs @ w_h2h.T + b_h2h
+            xr, xz, xn = jnp.split(xrzn, 3, axis=-1)
+            hr, hz, hn = jnp.split(hproj, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            nh = (1 - z) * n + z * hs
+            return (nh,), nh
+        return step
+    raise ValueError("unknown RNN mode %s" % mode)
+
+
+def _run_direction(x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, reverse):
+    """One layer, one direction.  x: (T, N, in) → (T, N, H), final states."""
+    T, N, _ = x.shape
+    h = h0.shape[-1]
+    # hoisted input projection: one (T*N, in) x (in, G*H) MXU matmul
+    xproj = (x.reshape(T * N, -1) @ w_i2h.T + b_i2h).reshape(T, N, -1)
+    step = _cell_step(mode, h)
+    carry = (h0,) if mode != "lstm" else (h0, c0)
+
+    def body(carry, xp):
+        return step(carry, xp, w_h2h, b_h2h)
+
+    carry, out = lax.scan(body, carry, xproj, reverse=reverse)
+    hT = carry[0]
+    cT = carry[1] if mode == "lstm" else None
+    return out, hT, cT
+
+
+@register("RNN", num_outputs=3, needs_training=True, needs_rng=True)
+def rnn_op(key, data, parameters, state, state_cell=None,
+           training: bool = False,
+           state_size: int = 0, num_layers: int = 1,
+           bidirectional: bool = False, mode: str = "lstm",
+           p: float = 0.0, state_outputs: bool = False,
+           lstm_state_clip_min=None, lstm_state_clip_max=None,
+           lstm_state_clip_nan: bool = False, use_sequence_length: bool = False):
+    """Fused multi-layer RNN (reference src/operator/rnn.cc ``RNN`` op).
+
+    data: (T, N, input) [TNC]; state: (L*dirs, N, H); returns
+    (output (T,N,dirs*H), state_h (L*dirs,N,H), state_c or dummy).
+    """
+    assert not use_sequence_length, "use_sequence_length: use SequenceMask"
+    dirs = 2 if bidirectional else 1
+    T, N, input_size = data.shape
+    h = state_size
+    weights, biases = _split_params(
+        parameters, num_layers, input_size, state_size, bidirectional, mode)
+    x = data
+    h_finals = []
+    c_finals = []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            w_i2h, w_h2h = weights[idx]
+            b_i2h, b_h2h = biases[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            out, hT, cT = _run_direction(
+                x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, reverse=(d == 1))
+            outs.append(out)
+            h_finals.append(hT)
+            if mode == "lstm":
+                if lstm_state_clip_min is not None and \
+                        lstm_state_clip_max is not None:
+                    cT = jnp.clip(cT, lstm_state_clip_min,
+                                  lstm_state_clip_max)
+                c_finals.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and training and layer != num_layers - 1 and key is not None:
+            keep = 1.0 - p
+            k = jax.random.fold_in(key, layer)
+            mask = jax.random.bernoulli(k, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0)
+    state_h = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        state_c = jnp.stack(c_finals, axis=0)
+    else:
+        state_c = jnp.zeros_like(state_h)
+    return x, state_h, state_c

