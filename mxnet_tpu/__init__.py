@@ -34,6 +34,9 @@ from . import image
 from . import gluon
 from . import parallel
 from . import profiler
+from . import symbol
+from . import symbol as sym
+from . import executor
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray
@@ -43,5 +46,6 @@ __all__ = [
     "current_context", "num_gpus", "num_tpus", "nd", "ndarray",
     "autograd", "random", "NDArray", "initializer", "init", "gluon",
     "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
-    "io", "recordio", "image", "parallel", "profiler",
+    "io", "recordio", "image", "parallel", "profiler", "symbol", "sym",
+    "executor",
 ]

@@ -1,0 +1,273 @@
+"""Executor: run a bound Symbol graph as compiled XLA programs.
+
+Reference: ``python/mxnet/executor.py:45`` (Executor wrapper) over
+``src/executor/graph_executor.cc`` (GraphExecutor::Init/Forward/Backward —
+nnvm passes, memory planning, engine op scheduling).
+
+TPU-native redesign: the whole DAG is evaluated by ONE pure function;
+``forward`` is that function under ``jax.jit`` (XLA does what
+MXGradient/MXPlanMemory/InitCachedOps did: autodiff, buffer assignment,
+fusion, scheduling), and ``backward`` is its ``jax.vjp`` — the
+linearization runs inside the same compiled forward, so a train step costs
+one fwd(+residuals) program plus one transpose program, with no per-op
+dispatch (the reference's RunOps loop, graph_executor.cc:1395, collapses
+into XLA).  Auxiliary states (BatchNorm moving stats) are extra functional
+outputs written back to the bound aux arrays, mirroring FMutateInputs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .symbol._eval import eval_node
+
+__all__ = ["Executor"]
+
+
+def build_graph_fn(symbol):
+    """Compile the Symbol DAG into a pure function
+    ``f(arg_vals, aux_vals, key, training) -> (outputs, new_aux)``."""
+    nodes = symbol._topo()
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    entries = list(symbol._entries)
+
+    def graph_fn(arg_vals, aux_vals, key, training):
+        arg_map = dict(zip(arg_names, arg_vals))
+        aux_map = dict(zip(aux_names, aux_vals))
+        new_aux = dict(aux_map)
+        env = {}
+        for idx, node in enumerate(nodes):
+            if node.op is None:
+                if node.name in arg_map:
+                    env[(id(node), 0)] = arg_map[node.name]
+                elif node.name in aux_map:
+                    env[(id(node), 0)] = aux_map[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+                continue
+            ins = [env[(id(c), i)] for c, i in node.inputs]
+            outs = eval_node(node, ins, jax.random.fold_in(key, idx),
+                             training)
+            if node.op == "BatchNorm" and node.in_names:
+                # moving-stat update (reference batch_norm-inl.h):
+                # moving = moving*momentum + batch*(1-momentum), train only
+                mom = float(node.attrs.get("momentum", 0.9))
+                use_global = node.attrs.get("use_global_stats", False)
+                if training and not use_global:
+                    batch = {"moving_mean": outs[1], "moving_var": outs[2]}
+                    for (c, _), pname in zip(node.inputs, node.in_names):
+                        if pname in batch and c.name in aux_map:
+                            new_aux[c.name] = (aux_map[c.name] * mom
+                                               + batch[pname] * (1.0 - mom))
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        out_vals = tuple(env[(id(n), i)] for n, i in entries)
+        return out_vals, tuple(new_aux[n] for n in aux_names)
+
+    return graph_fn
+
+
+def _ones_cot(x):
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.ones_like(x)
+    return onp.zeros(x.shape, jax.dtypes.float0)
+
+
+def _zeros_cot(x):
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.zeros_like(x)
+    return onp.zeros(x.shape, jax.dtypes.float0)
+
+
+class Executor:
+    """A Symbol bound to argument/gradient/aux arrays (reference
+    executor.py:45; created by ``Symbol.bind``/``simple_bind``)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        from . import ndarray as nd  # noqa: F401 (NDArray wrap helpers)
+        from .ndarray.ndarray import NDArray
+
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        def normalize(vals, names, what):
+            if vals is None:
+                return [None] * len(names)
+            if isinstance(vals, dict):
+                return [vals.get(n) for n in names]
+            vals = list(vals)
+            if len(vals) != len(names):
+                raise MXNetError(
+                    "Length of %s (%d) does not match number of names (%d)"
+                    % (what, len(vals), len(names)))
+            return vals
+
+        self.arg_arrays: List[NDArray] = normalize(args, arg_names, "args")
+        for n, a in zip(arg_names, self.arg_arrays):
+            if a is None:
+                raise MXNetError("argument %r is not bound" % n)
+        self.aux_arrays: List[NDArray] = [
+            a for a in normalize(aux_states, aux_names, "aux_states")]
+        for n, a in zip(aux_names, self.aux_arrays):
+            if a is None:
+                raise MXNetError("auxiliary state %r is not bound" % n)
+        self.grad_arrays: List[Optional[NDArray]] = normalize(
+            args_grad, arg_names, "args_grad")
+        if isinstance(grad_req, str):
+            reqs = [grad_req] * len(arg_names)
+        elif isinstance(grad_req, dict):
+            reqs = [grad_req.get(n, "null") for n in arg_names]
+        else:
+            reqs = list(grad_req)
+        self._grad_req = ["null" if g is None else r
+                         for r, g in zip(reqs, self.grad_arrays)]
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self.arg_dict: Dict[str, NDArray] = dict(zip(arg_names,
+                                                     self.arg_arrays))
+        self.aux_dict: Dict[str, NDArray] = dict(zip(aux_names,
+                                                     self.aux_arrays))
+        self.grad_dict: Dict[str, Optional[NDArray]] = dict(
+            zip(arg_names, self.grad_arrays))
+        self.outputs: List[NDArray] = []
+        self._jit_fwd = jax.jit(build_graph_fn(symbol),
+                                static_argnums=(3,))
+        self._vjp_state = None
+
+    # -- execution ------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Run forward; inputs may be passed as keyword NDArrays which are
+        copied into the bound arrays first (reference executor.py:90)."""
+        from .ndarray.ndarray import _wrap
+        from . import random as _random
+
+        dev = self._ctx.jax_device if self._ctx is not None else None
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError("unknown input %r" % name)
+            dst = self.arg_dict[name]
+            v = val._data.astype(dst._data.dtype) \
+                if val._data.dtype != dst._data.dtype else val._data
+            # cross-device feed: stage onto the executor's device (the
+            # reference copies into the bound NDArray the same way)
+            if dev is not None and dev not in v.devices():
+                v = jax.device_put(v, dev)
+            dst._data = v
+        arg_vals = tuple(a._data for a in self.arg_arrays)
+        aux_vals = tuple(a._data for a in self.aux_arrays)
+        key = _random.next_key()
+        if dev is not None and dev not in key.devices():
+            key = jax.device_put(key, dev)
+
+        diff_idx = [i for i, r in enumerate(self._grad_req)
+                    if r != "null" and self.grad_arrays[i] is not None]
+        # vjp is taken over the *jitted* graph fn, so the per-call Python
+        # cost is O(1) in graph size (one pjit primitive is differentiated,
+        # with its jvp/transpose jaxprs cached); both halves run compiled
+        if is_train and diff_idx:
+            base = list(arg_vals)
+
+            def f(dvals):
+                full = list(base)
+                for i, v in zip(diff_idx, dvals):
+                    full[i] = v
+                return self._jit_fwd(tuple(full), aux_vals, key, True)
+
+            (outs, new_aux), vjp = jax.vjp(
+                f, tuple(arg_vals[i] for i in diff_idx))
+            self._vjp_state = (vjp, outs, new_aux, diff_idx)
+        else:
+            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, key,
+                                          bool(is_train))
+            self._vjp_state = None
+        if is_train:
+            for a, v in zip(self.aux_arrays, new_aux):
+                a._data = v
+        self.outputs = [_wrap(o, getattr(self.arg_arrays[0], "_ctx", None)
+                              if self.arg_arrays else None) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Accumulate gradients into the bound grad arrays per grad_req.
+
+        With ``out_grads=None`` every head receives a ones cotangent — the
+        loss-op convention: SoftmaxOutput/MakeLoss register custom vjps that
+        ignore/scale the head gradient exactly as the reference's implicit
+        backward does (src/operator/softmax_output-inl.h)."""
+        if self._vjp_state is None:
+            raise MXNetError(
+                "backward() requires a prior forward(is_train=True) with "
+                "gradient arrays bound")
+        vjp, outs, new_aux, diff_idx = self._vjp_state
+        if out_grads is None:
+            cot_outs = tuple(_ones_cot(o) for o in outs)
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cot_outs = tuple(g._data if hasattr(g, "_data") else jnp.asarray(g)
+                             for g in out_grads)
+        cot_aux = tuple(_zeros_cot(a) for a in new_aux)
+        (dargs,) = vjp((cot_outs, cot_aux))
+        for j, i in enumerate(diff_idx):
+            g = dargs[j]
+            if g.dtype == jax.dtypes.float0:
+                continue
+            dst = self.grad_arrays[i]
+            if self._grad_req[i] == "add":
+                dst._data = dst._data + g
+            else:  # write
+                dst._data = g
+
+    # -- parameter management ------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """(reference executor.py:235)"""
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                dst = self.arg_dict[name]
+                dst._data = arr._data.astype(dst._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in arguments" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    dst = self.aux_dict[name]
+                    dst._data = arr._data.astype(dst._data.dtype)
+                elif not allow_extra_params:
+                    raise MXNetError("Found name %r not in aux states" % name)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new input shapes (reference graph_executor Reshape);
+        XLA recompiles per shape signature — the same shape-keyed plan
+        cache CachedOp keeps (cached_op.cc:307) lives in jit's cache."""
+        from . import ndarray as nd
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        args, grads = [], []
+        for name, shape, cur, grad in zip(self._arg_names, arg_shapes,
+                                          self.arg_arrays, self.grad_arrays):
+            if shape == tuple(cur.shape):
+                args.append(cur)
+                grads.append(grad)
+            else:
+                args.append(nd.zeros(shape, ctx=self._ctx,
+                                     dtype=cur.dtype))
+                grads.append(nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+                             if grad is not None else None)
+        aux = [cur if tuple(cur.shape) == shape
+               else nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+               for shape, cur in zip(aux_shapes, self.aux_arrays)]
+        return Executor(self._symbol, self._ctx, args, grads,
+                        self._grad_req, aux)

@@ -58,6 +58,24 @@ def flatten(data):
     return jnp.reshape(data, (data.shape[0], -1))
 
 
+@register("Reshape", aliases=("reshape",))
+def reshape(data, shape=(), reverse: bool = False, target_shape=None,
+            keep_highest: bool = False):
+    """Reshape with MXNet's special codes 0/-1/-2/-3/-4
+    (reference src/operator/tensor/matrix_op.cc Reshape)."""
+    from ..ndarray.ndarray import _infer_reshape
+    if target_shape:
+        # legacy arg (deprecated in the reference): 0 means "infer this
+        # dim"; keep_highest pins dim 0 to the input's
+        tgt = [(-1 if d == 0 else int(d)) for d in target_shape]
+        if keep_highest:
+            tgt[0] = data.shape[0]
+        return jnp.reshape(data, tuple(tgt))
+    new_shape = _infer_reshape(tuple(data.shape), tuple(shape),
+                               reverse=reverse)
+    return jnp.reshape(data, new_shape)
+
+
 @register("slice")
 def slice_op(data, begin=(), end=(), step=()):
     idx = []

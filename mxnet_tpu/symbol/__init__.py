@@ -1,0 +1,209 @@
+"""``mx.sym`` namespace: declarative Symbol API over the shared op registry.
+
+Mirrors the reference's import-time codegen of symbol op wrappers
+(``python/mxnet/symbol/register.py``) — here resolved lazily via PEP 562
+module ``__getattr__`` against the same registry that powers ``mx.nd``, so
+every imperative op is automatically available symbolically (the reference
+guarantees the same via one C op registry feeding both frontends).
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..ops.registry import get_op, list_ops
+from .symbol import (  # noqa: F401
+    Symbol, var, Variable, Group, load, load_json, zeros, ones,
+    _SymNode, _NAMES,
+)
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+
+# Optional learnable/label inputs auto-created as variables when omitted
+# (reference: ListArguments names from the op's FListInputNames).
+_OPTIONAL_INPUTS = ("weight", "bias", "gamma", "beta",
+                    "moving_mean", "moving_var", "label")
+
+# per-op gating of optional inputs: (param, attr-predicate) — the input
+# exists only when the predicate over attrs holds (reference examples:
+# Convolution's bias vanishes from list_arguments under no_bias).
+def _gate(op_name, param, attrs):
+    if param == "bias":
+        return not attrs.get("no_bias", _default_no_bias(op_name))
+    if param == "gamma" and op_name == "LeakyReLU":
+        return attrs.get("act_type", "leaky") == "prelu"
+    if param == "state_cell":
+        return attrs.get("mode", "lstm") == "lstm"
+    return True
+
+
+def _default_no_bias(op_name):
+    if op_name == "Deconvolution":
+        return True
+    return False
+
+
+_INPUT_CACHE = {}
+
+
+def _sig_params(op):
+    """All user-facing parameter names of ``op.fn`` in signature order
+    (``key``/``training`` are runtime-threaded, not user params)."""
+    return [p.name for p in inspect.signature(op.fn).parameters.values()
+            if p.name not in ("key", "training")
+            and p.kind not in (inspect.Parameter.VAR_POSITIONAL,
+                               inspect.Parameter.VAR_KEYWORD)]
+
+
+def _sig_input_params(op):
+    """Ordered parameter names of ``op.fn`` that are array inputs.
+
+    Convention across the ops package: required (default-less) params are
+    array inputs; well-known learnable/label names with a ``None`` default
+    are optional array inputs; everything else is a static attribute.
+    """
+    cached = _INPUT_CACHE.get(op.name)
+    if cached is not None:
+        return cached
+    names = []
+    for p in inspect.signature(op.fn).parameters.values():
+        if p.name in ("key", "training"):
+            continue
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD):
+            continue
+        if (p.default is inspect.Parameter.empty
+                or (p.default is None and p.name in _OPTIONAL_INPUTS)):
+            names.append(p.name)
+    _INPUT_CACHE[op.name] = names
+    return names
+
+
+def _input_params(op, attrs):
+    """Input param names applicable under the given attrs (gated)."""
+    return [n for n in _sig_input_params(op) if _gate(op.name, n, attrs)]
+
+
+# ops taking a variadic list of inputs (no fixed signature slots)
+_VARARG_OPS = {"Concat", "concat", "add_n", "ElementWiseSum",
+               "elemwise_sum", "stack"}
+
+
+def _invoke_op(op_name, inputs, attrs, name=None, in_names=None):
+    """Create a Symbol node applying ``op_name`` to input Symbols."""
+    op = get_op(op_name)
+    if op is None and op_name not in _VARARG_OPS:
+        raise ValueError("unknown op %r" % op_name)
+    if name is None:
+        hint = (op.name if op is not None else op_name).lower().replace(
+            ".", "_").lstrip("_")
+        name = _NAMES.get(hint)
+    entries = [s._entries[0] for s in inputs]
+    node = _SymNode(op_name, name, dict(attrs), entries,
+                    in_names=in_names)
+    return Symbol([(node, i) for i in range(node.num_outputs)])
+
+
+_SYM_FUNC_CACHE = {}
+
+
+def _make_sym_func(op):
+    """Build the ``mx.sym.<op>`` wrapper: Symbol args become graph inputs,
+    missing learnable inputs are auto-created as variables named
+    ``{name}_{param}`` (reference symbol composition semantics)."""
+    cached = _SYM_FUNC_CACHE.get(op.name)
+    if cached is not None:
+        return cached
+
+    def func(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        if name is None:
+            name = _NAMES.get(op.name.lower().replace(".", "_").lstrip("_"))
+        attrs = {}
+        given = {}
+        # positional args map onto the full signature: Symbols must land on
+        # input slots, non-Symbols skip ahead to the next attr slot (so
+        # e.g. Activation(x, 'relu') works like the reference's codegen)
+        params = _sig_params(op)
+        input_set = set(_sig_input_params(op))
+        if len(args) > len(params):
+            raise TypeError("%s takes at most %d arguments (%d given)"
+                            % (op.name, len(params), len(args)))
+        pi = 0
+        for a in args:
+            if isinstance(a, Symbol):
+                while pi < len(params) and params[pi] not in input_set:
+                    pi += 1
+                if pi == len(params):
+                    raise TypeError("too many Symbol inputs for op %s"
+                                    % op.name)
+                given[params[pi]] = a
+            else:
+                while pi < len(params) and params[pi] in input_set:
+                    pi += 1
+                if pi == len(params):
+                    raise TypeError("too many attribute arguments for op %s"
+                                    % op.name)
+                if a is not None:
+                    attrs[params[pi]] = a
+            pi += 1
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                given[k] = v
+            elif v is not None:
+                attrs[k] = v
+        inputs, in_names = [], []
+        for pname in _input_params(op, attrs):
+            if pname in given:
+                inputs.append(given.pop(pname))
+                in_names.append(pname)
+            elif pname in _OPTIONAL_INPUTS:
+                inputs.append(var("%s_%s" % (name, pname)))
+                in_names.append(pname)
+            # required-but-omitted inputs (e.g. a unary op called with no
+            # args) are a user error surfaced at bind time
+        if given:
+            raise TypeError("unexpected Symbol arguments %r for op %s"
+                            % (sorted(given), op.name))
+        return _invoke_op(op.name, inputs, attrs, name=name,
+                          in_names=in_names)
+
+    func.__name__ = op.name
+    func.__doc__ = op.doc
+    _SYM_FUNC_CACHE[op.name] = func
+    return func
+
+
+def Concat(*args, dim: int = 1, name=None, **kwargs):
+    """Variadic concat (reference src/operator/nn/concat.cc)."""
+    num_args = kwargs.pop("num_args", None)
+    return _invoke_op("Concat", list(args),
+                      {"dim": dim, "num_args": num_args or len(args)},
+                      name=name)
+
+
+concat = Concat
+
+
+def add_n(*args, name=None, **kwargs):
+    return _invoke_op("add_n", list(args), {}, name=name)
+
+
+ElementWiseSum = add_n
+
+
+def stack(*args, axis: int = 0, name=None, **kwargs):
+    return _invoke_op("stack", list(args), {"axis": axis}, name=name)
+
+
+def __getattr__(name):
+    op = get_op(name)
+    if op is None:
+        raise AttributeError("module 'symbol' has no attribute %r" % name)
+    return _make_sym_func(op)
+
+
+def __dir__():
+    return sorted(set(list(globals().keys()) + list_ops()))

@@ -1,0 +1,35 @@
+"""Shared jnp-level evaluation of one Symbol node.
+
+Used by both the Executor's graph function and shape inference so the
+vararg pseudo-ops (Concat/add_n/stack — variadic inputs, no registry
+signature) have exactly one dispatch site.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import get_op
+
+
+def eval_node(node, ins, key, training):
+    """Apply ``node``'s op to jnp inputs; returns a tuple of outputs.
+
+    ``key`` may be None when the caller guarantees no random ops (shape
+    inference passes a dummy)."""
+    attrs = dict(node.attrs)
+    attrs.pop("num_args", None)
+    if node.op in ("Concat", "concat"):
+        return (jnp.concatenate(ins, axis=int(attrs.get("dim", 1))),)
+    if node.op in ("add_n", "ElementWiseSum", "elemwise_sum"):
+        return (sum(ins[1:], ins[0]),)
+    if node.op == "stack":
+        return (jnp.stack(ins, axis=int(attrs.get("axis", 0))),)
+    op = get_op(node.op)
+    if op.needs_training:
+        attrs["training"] = training
+    if op.needs_rng:
+        res = op.fn(key, *ins, **attrs)
+    else:
+        res = op.fn(*ins, **attrs)
+    return tuple(res) if isinstance(res, (tuple, list)) else (res,)

@@ -1,0 +1,209 @@
+"""Graph shape inference for Symbol.
+
+The one nnvm service XLA doesn't replace: deriving *parameter* shapes from
+data shapes (the reference's per-op ``FInferShape`` run by
+``src/executor/infer_graph_attr_pass.cc``).  Output shapes come from
+``jax.eval_shape`` over the op's actual kernel — the kernel IS the shape
+function, so the table below only covers backward inference into
+default-less variable inputs (weights/biases/labels).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+
+def _prod(t):
+    p = 1
+    for v in t:
+        p *= v
+    return p
+
+
+def _norm_axis(axis, ndim):
+    return axis % ndim
+
+
+# -- parameter-shape rules (reference FInferShape backward direction) -----
+# rule(attrs, in_shapes) -> {param_name: shape} for inferable params;
+# in_shapes is parallel to node.in_names with None for unknowns.
+
+def _fc_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    nh = int(attrs.get("num_hidden", 0))
+    flatten = attrs.get("flatten", True)
+    in_dim = _prod(d[1:]) if (flatten and len(d) > 2) else d[-1]
+    return {"weight": (nh, in_dim), "bias": (nh,)}
+
+
+def _conv_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    k = tuple(attrs.get("kernel", ()))
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    return {"weight": (nf, d[1] // ng) + k, "bias": (nf,)}
+
+
+def _deconv_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    k = tuple(attrs.get("kernel", ()))
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    return {"weight": (d[1], nf // ng) + k, "bias": (nf,)}
+
+
+def _channel_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    axis = _norm_axis(int(attrs.get("axis", 1)), len(d))
+    c = (d[axis],)
+    return {"gamma": c, "beta": c, "moving_mean": c, "moving_var": c}
+
+
+def _layernorm_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    axis = _norm_axis(int(attrs.get("axis", -1)), len(d))
+    c = (d[axis],)
+    return {"gamma": c, "beta": c}
+
+
+def _embedding_rule(attrs, names, shapes):
+    return {"weight": (int(attrs.get("input_dim", 0)),
+                       int(attrs.get("output_dim", 0)))}
+
+
+def _prelu_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    return {"gamma": (d[1] if len(d) > 1 else d[0],)}
+
+
+def _softmax_out_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    if attrs.get("multi_output", False):
+        return {"label": (d[0],) + tuple(d[2:])}
+    return {"label": tuple(d[:-1])}
+
+
+def _regression_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    return {"label": tuple(d)}
+
+
+def _rnn_rule(attrs, names, shapes):
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    from ..ops.rnn import rnn_param_size
+    h = int(attrs.get("state_size", 0))
+    nl = int(attrs.get("num_layers", 1))
+    bi = bool(attrs.get("bidirectional", False))
+    mode = attrs.get("mode", "lstm")
+    dirs = 2 if bi else 1
+    n = rnn_param_size(nl, d[2], h, bi, mode)
+    st = (nl * dirs, d[1], h)
+    return {"parameters": (n,), "state": st, "state_cell": st}
+
+
+_PARAM_RULES = {
+    "FullyConnected": _fc_rule,
+    "Convolution": _conv_rule,
+    "Deconvolution": _deconv_rule,
+    "BatchNorm": _channel_rule,
+    "InstanceNorm": _layernorm_rule,
+    "GroupNorm": _channel_rule,
+    "LayerNorm": _layernorm_rule,
+    "Embedding": _embedding_rule,
+    "LeakyReLU": _prelu_rule,
+    "SoftmaxOutput": _softmax_out_rule,
+    "LinearRegressionOutput": _regression_rule,
+    "MAERegressionOutput": _regression_rule,
+    "LogisticRegressionOutput": _regression_rule,
+    "RNN": _rnn_rule,
+}
+
+
+def _abstract_out_shapes(node, in_shapes):
+    """Output shapes via jax.eval_shape over the registered kernel."""
+    from ._eval import eval_node
+    structs = [jax.ShapeDtypeStruct(tuple(s), onp.float32)
+               for s in in_shapes]
+    out = jax.eval_shape(
+        lambda *xs: eval_node(node, list(xs), jax.random.PRNGKey(0), False),
+        *structs)
+    return [tuple(o.shape) for o in out]
+
+
+def infer_graph_shapes(symbol, known: Dict[str, Tuple[int, ...]],
+                       partial: bool = False):
+    """Forward/backward shape propagation over the DAG.
+
+    Returns a dict of {var_name: shape} ∪ {("out", node_id, idx): shape};
+    undetermined entries are absent (callers see None).
+    """
+    shapes: Dict[object, Tuple[int, ...]] = {}
+    nodes = symbol._topo()
+    for node in nodes:
+        if node.op is None:
+            if node.name in known:
+                shapes[node.name] = tuple(known[node.name])
+            elif "__shape__" in node.attrs:
+                shapes[node.name] = tuple(node.attrs["__shape__"])
+
+    changed = True
+    while changed:
+        changed = False
+        for node in nodes:
+            if node.op is None:
+                continue
+            in_keys = [(c.name if c.op is None else ("out", id(c), i))
+                       for c, i in node.inputs]
+            in_shapes = [shapes.get(k) for k in in_keys]
+            # backward inference into default-less variable inputs
+            rule = _PARAM_RULES.get(node.op)
+            if rule is not None and node.in_names:
+                derived = rule(node.attrs, node.in_names, in_shapes)
+                for (c, _), pname, cur in zip(node.inputs, node.in_names,
+                                              in_shapes):
+                    if cur is None and c.op is None and pname in derived:
+                        shapes[c.name] = tuple(int(v) for v in
+                                               derived[pname])
+                        changed = True
+                in_shapes = [shapes.get(k) for k in in_keys]
+            # forward inference once every input is known
+            out_key0 = ("out", id(node), 0)
+            if out_key0 not in shapes and all(s is not None
+                                              for s in in_shapes):
+                try:
+                    outs = _abstract_out_shapes(node, in_shapes)
+                except Exception as e:  # inconsistent shapes
+                    raise MXNetError(
+                        "Error in operator %s: %s" % (node.name, e)) from None
+                for i, s in enumerate(outs):
+                    shapes[("out", id(node), i)] = s
+                changed = True
+
+    # surface output entries under the var name for var-headed entries
+    for node, idx in symbol._entries:
+        if node.op is None and node.name in shapes:
+            shapes[("out", id(node), idx)] = shapes[node.name]
+    return shapes

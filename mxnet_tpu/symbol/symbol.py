@@ -1,0 +1,461 @@
+"""Symbol: the declarative graph-construction API.
+
+Reference: ``python/mxnet/symbol/symbol.py`` (~4.8k LoC) over nnvm's graph
+IR — ``Symbol`` composition, ``list_arguments`` (:820), ``infer_shape``
+(:996), ``tojson``/``load``, ``bind`` (:1657), ``simple_bind`` (:1393),
+``eval``; the C++ side is ``src/nnvm/`` passes + GraphExecutor.
+
+TPU-native redesign: a Symbol is a lightweight expression DAG over the
+same op registry as ``mx.nd`` — NO separate graph compiler.  ``bind``
+produces an Executor whose forward/backward are the DAG evaluated as a
+pure function under ``jax.jit`` (XLA plays nnvm+GraphExecutor: shape
+inference, memory planning, fusion, scheduling).  Shape inference for
+*parameter* arguments (the one nnvm service XLA doesn't replace) is a
+per-op rule table mirroring the reference's FInferShape functions.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ops.registry import get_op, list_ops
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+
+class _NameManager(threading.local):
+    def __init__(self):
+        self.counts = {}
+
+    def get(self, hint):
+        cnt = self.counts.get(hint, 0)
+        self.counts[hint] = cnt + 1
+        return "%s%d" % (hint, cnt)
+
+
+_NAMES = _NameManager()
+
+# input param names that are auxiliary states (reference: mutable inputs
+# declared by FMutateInputs, e.g. BatchNorm's moving stats)
+_AUX_PARAMS = ("moving_mean", "moving_var")
+
+# ops whose extra outputs are aux-state updates rather than user outputs
+_PRIMARY_OUTPUTS = {"BatchNorm": 1}
+
+
+def _rnn_num_outputs(attrs):
+    """RNN heads follow state_outputs (reference rnn.cc ListOutputs):
+    output only, or output+state(+cell for lstm)."""
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+class _SymNode:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "in_names")
+
+    def __init__(self, op, name, attrs, inputs, in_names=None):
+        self.op = op  # str | None
+        self.name = name
+        self.attrs = attrs or {}
+        self.inputs = inputs  # list of (node, out_idx)
+        if in_names is None and op is not None:
+            from . import _input_params, _VARARG_OPS
+            opdef = get_op(op)
+            if opdef is not None and op not in _VARARG_OPS:
+                # reconstruct slot names (JSON load path): inputs were built
+                # in signature order, gated by attrs
+                in_names = _input_params(opdef, self.attrs)[:len(inputs)]
+        self.in_names = in_names
+        if op is None:
+            self.num_outputs = 1
+            return
+        opdef = get_op(op)
+        if opdef is None:  # vararg pseudo-op (Concat/add_n/stack)
+            self.num_outputs = 1
+        elif opdef.num_outputs == 0:  # variadic outputs (slice_channel)
+            self.num_outputs = int(self.attrs.get("num_outputs", 1))
+        elif opdef.name == "RNN":
+            self.num_outputs = _rnn_num_outputs(self.attrs)
+        else:
+            self.num_outputs = _PRIMARY_OUTPUTS.get(
+                opdef.name, opdef.num_outputs)
+
+
+class Symbol:
+    """Symbolic multi-output handle (reference symbol.py Symbol)."""
+
+    def __init__(self, entries: Sequence[Tuple[_SymNode, int]]):
+        self._entries = list(entries)
+
+    # -- composition helpers -------------------------------------------
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    def attr(self, key):
+        if len(self._entries) == 1:
+            return self._entries[0][0].attrs.get(key)
+        return None
+
+    def list_attr(self):
+        return dict(self._entries[0][0].attrs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for i, name in enumerate(self.list_outputs()):
+                if name == index:
+                    return Symbol([self._entries[i]])
+            raise ValueError("no output named %r" % index)
+        return Symbol([self._entries[index]])
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._entries)
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group")
+
+    def get_internals(self):
+        """Symbol of every internal output (reference get_internals)."""
+        entries = []
+        for node in self._topo():
+            if node.op is None:
+                entries.append((node, 0))
+            else:
+                for i in range(node.num_outputs):
+                    entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        node = self._entries[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- traversal ------------------------------------------------------
+    def _topo(self) -> List[_SymNode]:
+        order, seen = [], set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for child, _ in node.inputs:
+                visit(child)
+            order.append(node)
+
+        for node, _ in self._entries:
+            visit(node)
+        return order
+
+    def _aux_nodes(self):
+        aux = []
+        seen = set()
+        for node in self._topo():
+            if node.op is None or not node.in_names:
+                continue
+            for (child, _), pname in zip(node.inputs, node.in_names):
+                if (pname in _AUX_PARAMS and child.op is None
+                        and id(child) not in seen):
+                    seen.add(id(child))
+                    aux.append(child)
+        return aux
+
+    def list_arguments(self):
+        """Free variables in DFS order, aux excluded (reference :820)."""
+        aux_ids = {id(n) for n in self._aux_nodes()}
+        return [n.name for n in self._topo()
+                if n.op is None and id(n) not in aux_ids]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._aux_nodes()]
+
+    def list_outputs(self):
+        outs = []
+        for node, idx in self._entries:
+            if node.op is None:
+                outs.append(node.name)
+            elif node.num_outputs == 1:
+                outs.append(node.name + "_output")
+            else:
+                outs.append("%s_output%d" % (node.name, idx))
+        return outs
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.op is None]
+
+    # -- arithmetic sugar (reference symbol.py operator overloads) ------
+    def _binop(self, other, op_name, scalar_op, rev=False):
+        from . import _invoke_op
+        if isinstance(other, Symbol):
+            a, b = (other, self) if rev else (self, other)
+            return _invoke_op(op_name, [a, b], {})
+        a = self
+        attrs = {"scalar": float(other)}
+        return _invoke_op(scalar_op, [a], attrs)
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", "_rminus_scalar", rev=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", "_rdiv_scalar", rev=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __getattr__(self, name):
+        # fluent op calls: sym.reshape(...), sym.sum(...) etc.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        op = get_op(name)
+        if op is None:
+            raise AttributeError("Symbol has no attribute %r" % name)
+        from . import _make_sym_func
+        fn = _make_sym_func(op)
+
+        def method(*args, **kwargs):
+            return fn(self, *args, **kwargs)
+
+        return method
+
+    # -- shape/type inference ------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) — reference :996.
+
+        Known shapes are given for data variables; parameter shapes are
+        derived by the per-op rules; output shapes by abstract evaluation.
+        """
+        return self._infer_shape_impl(args, kwargs, partial=False)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(args, kwargs, partial=True)
+
+    def _infer_shape_impl(self, args, kwargs, partial):
+        from ._infer import infer_graph_shapes
+        known = {}
+        if args:
+            for name, shape in zip(self.list_arguments(), args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items()})
+        shapes = infer_graph_shapes(self, known, partial=partial)
+        arg_shapes = [shapes.get(n) for n in self.list_arguments()]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [shapes.get(("out", id(node), idx))
+                      for node, idx in self._entries]
+        if not partial:
+            # reference infer_shape demands a fully-determined graph;
+            # infer_shape_partial is the Nones-allowed variant
+            unknown = [n for n, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            if unknown or any(s is None for s in out_shapes):
+                raise MXNetError(
+                    "infer_shape: graph underdetermined; cannot infer "
+                    "shapes of arguments %r (provide their shapes or more "
+                    "input shapes, or use infer_shape_partial)" % (unknown,))
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """All-float32 default typing (reference infer_type); dtype
+        tracking follows the bound arrays at execution time."""
+        n_args = len(self.list_arguments())
+        dt = onp.float32
+        return ([dt] * n_args, [dt] * len(self._entries),
+                [dt] * len(self.list_auxiliary_states()))
+
+    # -- serialization --------------------------------------------------
+    def tojson(self):
+        """Graph JSON (reference tojson; same nodes/arg_nodes/heads
+        structure so tooling can introspect it)."""
+        nodes = self._topo()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            # every attr value is json.dumps'ed (strings included) so load
+            # can json.loads unambiguously; reference JSON (plain strings)
+            # still loads via the fallback in load_json
+            out_nodes.append({
+                "op": n.op if n.op is not None else "null",
+                "name": n.name,
+                "attrs": {k: json.dumps(v) for k, v in n.attrs.items()},
+                "inputs": [[nid[id(c)], i, 0] for c, i in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(nodes) if n.op is None]
+        heads = [[nid[id(n)], i, 0] for n, i in self._entries]
+        return json.dumps({"nodes": out_nodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10500]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- evaluation -----------------------------------------------------
+    def eval_imperative(self, arg_dict):
+        """Run the graph eagerly on NDArrays (tape-recording — used by
+        gluon.SymbolBlock and Symbol.eval)."""
+        from .. import ndarray as nd
+
+        values: Dict[Tuple[int, int], object] = {}
+        for node in self._topo():
+            if node.op is None:
+                if node.name not in arg_dict:
+                    raise MXNetError("missing argument %r" % node.name)
+                values[(id(node), 0)] = arg_dict[node.name]
+                continue
+            ins = [values[(id(c), i)] for c, i in node.inputs]
+            attrs = dict(node.attrs)
+            if node.op in ("Concat", "concat"):
+                out = nd.concat(*ins, dim=attrs.get("dim", 1))
+            elif node.op in ("add_n", "ElementWiseSum", "elemwise_sum"):
+                out = nd.add_n(*ins)
+            elif node.op == "stack":
+                out = nd.stack(*ins, axis=attrs.get("axis", 0))
+            else:
+                attrs.pop("num_args", None)
+                fn = getattr(nd, node.op)
+                out = fn(*ins, **attrs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for i, o in enumerate(outs):
+                values[(id(node), i)] = o
+        results = [values[(id(n), i)] for n, i in self._entries]
+        return results if len(results) > 1 else results[0]
+
+    def eval(self, ctx=None, **kwargs):
+        """(reference symbol.py eval): returns list of NDArrays."""
+        out = self.eval_imperative(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """Create an Executor with user-allocated arrays (reference
+        :1657)."""
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Infer shapes, allocate, bind (reference :1393)."""
+        from .. import ndarray as nd
+        from ..executor import Executor
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        args = [nd.zeros(s, ctx=ctx) for s in arg_shapes]
+        aux = [nd.zeros(s, ctx=ctx) for s in aux_shapes]
+        if grad_req != "null":
+            args_grad = [nd.zeros(s, ctx=ctx) for s in arg_shapes]
+        else:
+            args_grad = None
+        return Executor(self, ctx, args, args_grad, grad_req, aux)
+
+    # gluon interop
+    def var_names(self):
+        return self.list_inputs()
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference symbol.py var/Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if dtype is not None:
+        attrs["__dtype__"] = str(onp.dtype(dtype))
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    node = _SymNode(None, name, attrs, [])
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (reference Group)."""
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def zeros(shape, dtype=None, **kwargs):
+    from . import _invoke_op
+    return _invoke_op("_zeros", [], {"shape": tuple(shape),
+                                     "dtype": dtype or "float32"})
+
+
+def ones(shape, dtype=None, **kwargs):
+    from . import _invoke_op
+    return _invoke_op("_ones", [], {"shape": tuple(shape),
+                                    "dtype": dtype or "float32"})
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from graph JSON (reference load_json)."""
+    data = json.loads(json_str)
+    nodes = []
+    for spec in data["nodes"]:
+        attrs = {}
+        for k, v in spec.get("attrs", {}).items():
+            if isinstance(v, str):
+                try:
+                    attrs[k] = json.loads(v)
+                except (ValueError, TypeError):
+                    attrs[k] = v
+            else:
+                attrs[k] = v
+        # json round-trips tuples as lists; ops expect hashable attrs
+        attrs = {k: tuple(v) if isinstance(v, list) else v
+                 for k, v in attrs.items()}
+        op = spec["op"]
+        inputs = [(nodes[nid], out_idx) for nid, out_idx, _ in spec["inputs"]]
+        node = _SymNode(None if op == "null" else op, spec["name"], attrs,
+                        inputs)
+        nodes.append(node)
+    entries = [(nodes[nid], idx) for nid, idx, _ in data["heads"]]
+    return Symbol(entries)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

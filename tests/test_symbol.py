@@ -1,0 +1,169 @@
+"""Symbol/Executor tests (reference: tests/python/unittest/test_symbol.py
+and test_executor.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_list_arguments():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_infer_shape_mlp():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 10))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 10)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (4, 16)
+    assert d["softmax_label"] == (8,)
+    assert out_shapes == [(8, 4)]
+
+
+def test_infer_shape_conv_bn():
+    data = sym.var("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv1")
+    b = sym.BatchNorm(c, name="bn1")
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = p.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(p.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["conv1_bias"] == (8,)
+    assert d["bn1_gamma"] == (8,)
+    a = dict(zip(p.list_auxiliary_states(), aux_shapes))
+    assert a["bn1_moving_mean"] == (8,)
+    assert a["bn1_moving_var"] == (8,)
+    assert out_shapes == [(2, 8, 4, 4)]
+    assert p.list_auxiliary_states() == ["bn1_moving_mean", "bn1_moving_var"]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(4, 10))
+    assert out_shapes == [(4, 4)]
+
+
+def test_symbol_arithmetic_eval():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + 2 * b) / 3
+    av = mx.nd.array(onp.array([1.0, 2.0], onp.float32))
+    bv = mx.nd.array(onp.array([4.0, 5.0], onp.float32))
+    (out,) = c.eval(a=av, b=bv)
+    onp.testing.assert_allclose(out.asnumpy(), [3.0, 4.0], rtol=1e-6)
+
+
+def test_simple_bind_forward():
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.cpu(), data=(8, 10))
+    rs = onp.random.RandomState(0)
+    exe.arg_dict["data"][:] = rs.uniform(size=(8, 10)).astype(onp.float32)
+    exe.arg_dict["fc1_weight"][:] = rs.uniform(-0.1, 0.1, (16, 10)).astype(onp.float32)
+    exe.arg_dict["fc2_weight"][:] = rs.uniform(-0.1, 0.1, (4, 16)).astype(onp.float32)
+    outs = exe.forward(is_train=False)
+    out = outs[0].asnumpy()
+    assert out.shape == (8, 4)
+    onp.testing.assert_allclose(out.sum(axis=1), onp.ones(8), rtol=1e-5)
+
+
+def test_executor_backward_softmax_grad():
+    # SoftmaxOutput backward = (softmax - one_hot(label)) / like reference
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.cpu(), data=(8, 10))
+    rs = onp.random.RandomState(1)
+    exe.arg_dict["data"][:] = rs.uniform(size=(8, 10)).astype(onp.float32)
+    exe.arg_dict["fc1_weight"][:] = rs.uniform(-0.1, 0.1, (16, 10)).astype(onp.float32)
+    exe.arg_dict["fc2_weight"][:] = rs.uniform(-0.1, 0.1, (4, 16)).astype(onp.float32)
+    label = rs.randint(0, 4, (8,)).astype(onp.float32)
+    exe.arg_dict["softmax_label"][:] = label
+    exe.forward(is_train=True)
+    probs = exe.outputs[0].asnumpy()
+    exe.backward()
+    # check grad wrt fc2_bias: sum over batch of (p - onehot)
+    onehot = onp.eye(4)[label.astype(int)]
+    expect = (probs - onehot).sum(axis=0)
+    got = exe.grad_dict["fc2_bias"].asnumpy()
+    onp.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_executor_grad_req_add_and_null():
+    x = sym.var("x")
+    y = (x * x).sum()
+    xv = mx.nd.array(onp.array([1.0, 2.0, 3.0], onp.float32))
+    g = mx.nd.zeros((3,))
+    exe = y.bind(ctx=mx.cpu(), args=[xv], args_grad=[g], grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward()
+    exe.forward(is_train=True)
+    exe.backward()
+    onp.testing.assert_allclose(g.asnumpy(), [4.0, 8.0, 12.0], rtol=1e-6)
+    exe2 = y.bind(ctx=mx.cpu(), args=[xv], args_grad=None, grad_req="null")
+    exe2.forward(is_train=False)
+    onp.testing.assert_allclose(exe2.outputs[0].asnumpy(), 14.0, rtol=1e-6)
+
+
+def test_batchnorm_aux_update():
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, name="bn", fix_gamma=False, momentum=0.5)
+    exe = bn.simple_bind(ctx=mx.cpu(), data=(4, 2))
+    rs = onp.random.RandomState(2)
+    x = rs.normal(3.0, 2.0, (4, 2)).astype(onp.float32)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["bn_gamma"][:] = onp.ones(2, onp.float32)
+    exe.aux_dict["bn_moving_var"][:] = onp.ones(2, onp.float32)
+    exe.forward(is_train=True)
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    expect = 0.5 * 0.0 + 0.5 * x.mean(axis=0)
+    onp.testing.assert_allclose(mm, expect, rtol=1e-5)
+
+
+def test_get_internals_and_group():
+    net = _mlp()
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    grp = sym.Group([fc1, net])
+    assert len(grp.list_outputs()) == 2
+
+
+def test_variadic_concat():
+    a, b = sym.var("a"), sym.var("b")
+    c = sym.Concat(a, b, dim=1)
+    av = mx.nd.ones((2, 3))
+    bv = mx.nd.zeros((2, 2))
+    exe = c.bind(ctx=mx.cpu(), args={"a": av, "b": bv}, grad_req="null")
+    exe.forward()
+    assert exe.outputs[0].shape == (2, 5)
+    _, out_shapes, _ = c.infer_shape(a=(2, 3), b=(2, 2))
+    assert out_shapes == [(2, 5)]
+
+
+def test_fluent_and_scalar_ops():
+    x = sym.var("x")
+    y = x.reshape(shape=(2, 2)) + 1.0
+    xv = mx.nd.array(onp.arange(4, dtype=onp.float32))
+    exe = y.bind(ctx=mx.cpu(), args=[xv], grad_req="null")
+    exe.forward()
+    onp.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                                onp.arange(4).reshape(2, 2) + 1.0)
