@@ -37,6 +37,10 @@ from . import profiler
 from . import symbol
 from . import symbol as sym
 from . import executor
+from . import model
+from . import module
+from . import module as mod
+from . import callback
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray
@@ -47,5 +51,5 @@ __all__ = [
     "autograd", "random", "NDArray", "initializer", "init", "gluon",
     "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
     "io", "recordio", "image", "parallel", "profiler", "symbol", "sym",
-    "executor",
+    "executor", "model", "module", "mod", "callback",
 ]

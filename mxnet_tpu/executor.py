@@ -89,7 +89,7 @@ class Executor:
     executor.py:45; created by ``Symbol.bind``/``simple_bind``)."""
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, _shared_jit=None):
         from . import ndarray as nd  # noqa: F401 (NDArray wrap helpers)
         from .ndarray.ndarray import NDArray
 
@@ -139,8 +139,11 @@ class Executor:
         self.grad_dict: Dict[str, Optional[NDArray]] = dict(
             zip(arg_names, self.grad_arrays))
         self.outputs: List[NDArray] = []
-        self._jit_fwd = jax.jit(build_graph_fn(symbol),
-                                static_argnums=(3,))
+        # one jit per symbol, shared across reshape()-derived executors so
+        # the shape-keyed compile cache survives batch-size changes (the
+        # role of CachedOp's plan cache, cached_op.cc:307)
+        self._jit_fwd = _shared_jit if _shared_jit is not None else \
+            jax.jit(build_graph_fn(symbol), static_argnums=(3,))
         self._vjp_state = None
 
     # -- execution ------------------------------------------------------
@@ -165,7 +168,16 @@ class Executor:
         arg_vals = tuple(a._data for a in self.arg_arrays)
         aux_vals = tuple(a._data for a in self.aux_arrays)
         key = _random.next_key()
-        if dev is not None and dev not in key.devices():
+        mesh_sharding = next(
+            (v.sharding for v in arg_vals
+             if hasattr(v, "sharding")
+             and isinstance(v.sharding, jax.sharding.NamedSharding)
+             and len(v.sharding.device_set) > 1), None)
+        if mesh_sharding is not None:
+            # args live on a mesh (Module dp path): replicate the key
+            key = jax.device_put(key, jax.sharding.NamedSharding(
+                mesh_sharding.mesh, jax.sharding.PartitionSpec()))
+        elif dev is not None and dev not in key.devices():
             key = jax.device_put(key, dev)
 
         diff_idx = [i for i, r in enumerate(self._grad_req)
@@ -251,8 +263,8 @@ class Executor:
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new input shapes (reference graph_executor Reshape);
-        XLA recompiles per shape signature — the same shape-keyed plan
-        cache CachedOp keeps (cached_op.cc:307) lives in jit's cache."""
+        the jitted graph fn is shared with the new executor, so switching
+        back to a previously-seen shape hits the existing compile cache."""
         from . import ndarray as nd
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         args, grads = [], []
@@ -270,4 +282,4 @@ class Executor:
                else nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
                for shape, cur in zip(aux_shapes, self.aux_arrays)]
         return Executor(self._symbol, self._ctx, args, grads,
-                        self._grad_req, aux)
+                        self._grad_req, aux, _shared_jit=self._jit_fwd)

@@ -84,14 +84,47 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._local_sum_offset = 0.0
+        self._local_num_offset = 0
+
+    def reset_local(self):
+        """Clear only the recent window (reference metric.py reset_local):
+        ``get()`` then reports values since this call, ``get_global()`` the
+        run total.  Implemented as offsets into the monotonic accumulators
+        so subclasses need no changes."""
+        self._local_sum_offset = self.sum_metric
+        self._local_num_offset = self.num_inst
+
+    def _local_offsets(self):
+        off_s = getattr(self, "_local_sum_offset", 0.0)
+        off_n = getattr(self, "_local_num_offset", 0)
+        if off_n > self.num_inst:  # a subclass reset() skipped the offsets
+            return 0.0, 0
+        return off_s, off_n
 
     def get(self):
+        off_s, off_n = self._local_offsets()
+        num = self.num_inst - off_n
+        if num == 0:
+            return (self.name, float("nan"))
+        return (self.name, (self.sum_metric - off_s) / num)
+
+    def get_global(self):
+        """Run-total value ignoring reset_local (reference get_global)."""
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
 
     def get_name_value(self):
         name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        name, value = self.get_global()
         if not isinstance(name, list):
             name = [name]
         if not isinstance(value, list):
@@ -184,11 +217,18 @@ class CompositeEvalMetric(EvalMetric):
         except AttributeError:
             pass
 
-    def get(self):
+    def reset_local(self):
+        try:
+            for metric in self.metrics:
+                metric.reset_local()
+        except AttributeError:
+            pass
+
+    def _gather(self, getter):
         names = []
         values = []
         for metric in self.metrics:
-            name, value = metric.get()
+            name, value = getter(metric)
             if isinstance(name, str):
                 name = [name]
             if isinstance(value, (int, float)):
@@ -196,6 +236,12 @@ class CompositeEvalMetric(EvalMetric):
             names.extend(name)
             values.extend(value)
         return (names, values)
+
+    def get(self):
+        return self._gather(lambda m: m.get())
+
+    def get_global(self):
+        return self._gather(lambda m: m.get_global())
 
     def get_config(self):
         config = super().get_config()
@@ -366,8 +412,7 @@ class F1(EvalMetric):
             self.num_inst = self.metrics.total_examples
 
     def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0
+        super().reset()
         if hasattr(self, "metrics"):
             self.metrics.reset_stats()
 
@@ -396,8 +441,7 @@ class MCC(EvalMetric):
             self.num_inst = self._metrics.total_examples
 
     def reset(self):
-        self.sum_metric = 0.0
-        self.num_inst = 0.0
+        super().reset()
         if hasattr(self, "_metrics"):
             self._metrics.reset_stats()
 
@@ -436,6 +480,13 @@ class Perplexity(EvalMetric):
         self.num_inst += num
 
     def get(self):
+        off_s, off_n = self._local_offsets()
+        num = self.num_inst - off_n
+        if num == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp((self.sum_metric - off_s) / num))
+
+    def get_global(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))

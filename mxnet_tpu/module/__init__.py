@@ -1,0 +1,7 @@
+"""``mx.mod``: the Module training API (reference
+``python/mxnet/module/``)."""
+from .base_module import BaseModule  # noqa: F401
+from .module import Module  # noqa: F401
+from .bucketing_module import BucketingModule  # noqa: F401
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

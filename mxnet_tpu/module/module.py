@@ -1,0 +1,390 @@
+"""Module: the intermediate-level symbolic training interface (reference
+``python/mxnet/module/module.py`` — bind :364, init_optimizer :474,
+forward :575, backward :629, update :646).
+
+TPU-native redesign of DataParallelExecutorGroup
+(``module/executor_group.py:144``): instead of one executor per device with
+host-side batch slicing (decide_slices :282) and kvstore reduce, there is
+ONE Executor whose jitted program runs over a jax ``Mesh`` — the batch is
+sharded over the ``dp`` axis with ``NamedSharding``, parameters are
+replicated, and XLA/GSPMD inserts the gradient all-reduce where the
+reference pushed grads through KVStore.  ``update()`` keeps the reference's
+kvstore/updater contract on top.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import context as ctx_mod
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..initializer import InitDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from .base_module import BaseModule, _as_list
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """Symbolic training module over one Symbol (reference
+    module/module.py:50)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = ctx_mod.current_context()
+        self._contexts = _as_list(context)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._mesh = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._optimizer = None
+        self._kvstore = None
+        self._updater = None
+        self._update_on_kvstore = None
+        self._grad_req = "write"
+        self._preloaded = None
+        self._states_fname = None
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return list(zip(self.output_names,
+                        [tuple(o.shape) for o in self._exec.outputs])) \
+            if self._exec.outputs else None
+
+    # -- binding ---------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Infer all shapes, allocate arrays, create the Executor
+        (reference module.py:364 → simple_bind per device; here one
+        GSPMD-partitioned executor)."""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        from .. import ndarray as nd
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+
+        def _norm(shapes):
+            out = []
+            for s in shapes or []:
+                if hasattr(s, "name"):
+                    out.append((s.name, tuple(s.shape)))
+                else:
+                    out.append((s[0], tuple(s[1])))
+            return out
+
+        self._data_shapes = _norm(data_shapes)
+        self._label_shapes = _norm(label_shapes)
+        known = dict(self._data_shapes + self._label_shapes)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**known)
+        arg_names = self._symbol.list_arguments()
+        shape_of = dict(zip(arg_names, arg_shapes))
+
+        main_ctx = self._contexts[0]
+        if len(self._contexts) > 1:
+            # dp mesh over the given contexts (the reference's per-GPU
+            # executor group becomes one sharded program)
+            from jax.sharding import Mesh
+            import numpy as onp
+            devs = [c.jax_device for c in self._contexts]
+            self._mesh = Mesh(onp.array(devs), ("dp",))
+
+        shared_args = {}
+        if shared_module is not None and shared_module._exec is not None:
+            # share parameter arrays only — data/label arrays are
+            # per-bucket shapes (reference shares via the memory pool)
+            shared_args = {n: a for n, a in
+                           shared_module._exec.arg_dict.items()
+                           if n in shared_module._param_names}
+
+        args, grads, reqs = [], [], []
+        for name in arg_names:
+            if name in shared_args:
+                arr = shared_args[name]
+            else:
+                arr = nd.zeros(shape_of[name], ctx=main_ctx)
+            args.append(arr)
+            if name in self._data_names:
+                req = "write" if (for_training and inputs_need_grad) \
+                    else "null"
+            elif name in self._label_names or not for_training \
+                    or name in self._fixed_param_names:
+                req = "null"
+            elif isinstance(grad_req, dict):
+                req = grad_req.get(name, "write")
+            else:
+                req = grad_req
+            reqs.append(req)
+            grads.append(nd.zeros(shape_of[name], ctx=main_ctx)
+                         if req != "null" else None)
+        shared_aux = (shared_module._exec.aux_dict
+                      if shared_module is not None
+                      and shared_module._exec is not None else {})
+        aux = [shared_aux.get(n) if n in shared_aux
+               else nd.zeros(s, ctx=main_ctx)
+               for n, s in zip(self._aux_names, aux_shapes)]
+
+        from ..executor import Executor
+        self._exec = Executor(self._symbol, main_ctx, args, grads, reqs,
+                              aux)
+        if self._mesh is not None:
+            self._replicate_params()
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.params_initialized = True
+
+    def _replicate_params(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(self._mesh, P())
+        for arr in (self._exec.arg_arrays + self._exec.aux_arrays
+                    + [g for g in self._exec.grad_arrays if g is not None]):
+            arr._data = jax.device_put(arr._data, rep)
+
+    # -- parameters ------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n] for n in self._param_names}
+        aux = dict(self._exec.aux_dict)
+        return arg, aux
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """(reference module.py:281)"""
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if arg_params is None and getattr(self, "_preloaded", None):
+            arg_params, aux_params = self._preloaded
+
+        import jax
+        dev = self._contexts[0].jax_device
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                src = cache[name]
+                if src is not arr:
+                    v = src._data.astype(arr.dtype) \
+                        if src.dtype != arr.dtype else src._data
+                    if dev not in v.devices():  # e.g. params loaded on CPU
+                        v = jax.device_put(v, dev)
+                    arr._data = v
+            elif cache is not None and not allow_missing:
+                raise MXNetError("%s is not presented" % name)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+
+        for name in self._param_names:
+            _impl(name, self._exec.arg_dict[name], arg_params)
+        for name in self._aux_names:
+            _impl(name, self._exec.aux_dict[name], aux_params)
+        if arg_params is not None and not allow_extra:
+            extra = set(arg_params) - set(self._param_names) \
+                - set(self._data_names) - set(self._label_names)
+            if extra:
+                raise MXNetError(
+                    "arg_params contains names not in the symbol: %r "
+                    "(pass allow_extra=True to ignore)" % sorted(extra))
+        if self._mesh is not None:
+            self._replicate_params()
+        self.params_initialized = True
+
+    # -- optimizer -------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """(reference module.py:474)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, "
+                                "ignoring...")
+            return
+        arg_dict = {n: self._exec.arg_dict[n] for n in self._param_names}
+        kv, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._contexts), arg_dict)
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._param_names))
+            optimizer_params = dict(optimizer_params)
+            optimizer = opt_mod.create(optimizer,
+                                       param_idx2name=idx2name,
+                                       **optimizer_params)
+        self._optimizer = optimizer
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kv is not None:
+            if update_on_kvstore:
+                kv.set_optimizer(optimizer)
+            _initialize_kvstore(
+                kvstore=kv,
+                param_arrays=[arg_dict[n] for n in self._param_names],
+                arg_params=arg_dict, param_names=self._param_names,
+                update_on_kvstore=update_on_kvstore)
+        if not update_on_kvstore:
+            self._updater = opt_mod.get_updater(optimizer)
+        if self._mesh is not None:
+            self._replicate_params()  # kv.pull lands on one device
+        self.optimizer_initialized = True
+        states = getattr(self, "_states_fname", None)
+        if states:  # Module.load(load_optimizer_states=True)
+            self.load_optimizer_states(states)
+            self._states_fname = None
+
+    # -- computation -----------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """(reference module.py:575); reshapes on changed batch shapes the
+        way the reference re-binds (module.py:590-607)."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        new_shapes = {n: tuple(a.shape) for n, a in feeds.items()}
+        cur_shapes = {n: tuple(self._exec.arg_dict[n].shape)
+                      for n in feeds}
+        if new_shapes != cur_shapes:
+            self._exec = self._exec.reshape(**new_shapes)
+        if self._mesh is not None:
+            self._feed_sharded(feeds)
+            self._exec.forward(is_train=is_train)
+        else:
+            self._exec.forward(is_train=is_train, **feeds)
+
+    def _feed_sharded(self, feeds):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shard = NamedSharding(self._mesh, P("dp"))
+        for name, arr in feeds.items():
+            dst = self._exec.arg_dict[name]
+            v = arr._data.astype(dst.dtype) if arr.dtype != dst.dtype \
+                else arr._data
+            dst._data = jax.device_put(v, shard)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply the optimizer (reference module.py:646 →
+        model.py:122/150)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        params = [self._exec.arg_dict[n] for n in self._param_names]
+        grads = [self._exec.grad_dict[n] for n in self._param_names]
+        if self._kvstore is not None and self._update_on_kvstore:
+            _update_params_on_kvstore(params, grads, self._kvstore,
+                                      self._param_names)
+        else:
+            _update_params(params, grads, updater=self._updater,
+                           num_device=len(self._contexts),
+                           kvstore=self._kvstore,
+                           param_names=self._param_names)
+        if self._mesh is not None:
+            # eager optimizer math may land results on one device (state
+            # arrays are created per-context); restore mesh replication so
+            # the next jitted forward sees consistent placements
+            self._replicate_params()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        if isinstance(labels, dict):
+            labels_ = labels
+        else:
+            labels_ = dict(zip(self._label_names, labels or []))
+        preds = dict(zip(self.output_names, self._exec.outputs))
+        eval_metric.update_dict(labels_, preds)
+
+    def install_monitor(self, mon):
+        """Monitor taps outputs post-hoc (no per-op engine callbacks on
+        XLA; see mxnet_tpu.monitor)."""
+        mon.install(self)
+
+    # -- checkpointing ---------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        """(reference module.py save_checkpoint)"""
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """(reference module.py load): params are stashed and applied at
+        the first init_params after bind."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=symbol, **kwargs)
+        mod._preloaded = (arg_params, aux_params)
+        mod._states_fname = "%s-%04d.states" % (prefix, epoch) \
+            if load_optimizer_states else None
+        return mod
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as fin:
+                self._updater.set_states(fin.read())

@@ -81,6 +81,15 @@ def _layernorm_rule(attrs, names, shapes):
     return {"gamma": c, "beta": c}
 
 
+def _instancenorm_rule(attrs, names, shapes):
+    # gamma/beta are per-channel (axis 1, no axis attr on the op)
+    d = shapes[names.index("data")] if "data" in names else None
+    if d is None:
+        return {}
+    c = (d[1],)
+    return {"gamma": c, "beta": c}
+
+
 def _embedding_rule(attrs, names, shapes):
     return {"weight": (int(attrs.get("input_dim", 0)),
                        int(attrs.get("output_dim", 0)))}
@@ -129,7 +138,7 @@ _PARAM_RULES = {
     "Convolution": _conv_rule,
     "Deconvolution": _deconv_rule,
     "BatchNorm": _channel_rule,
-    "InstanceNorm": _layernorm_rule,
+    "InstanceNorm": _instancenorm_rule,
     "GroupNorm": _channel_rule,
     "LayerNorm": _layernorm_rule,
     "Embedding": _embedding_rule,
