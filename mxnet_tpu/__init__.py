@@ -41,6 +41,7 @@ from . import model
 from . import module
 from . import module as mod
 from . import callback
+from . import contrib
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray
@@ -51,5 +52,5 @@ __all__ = [
     "autograd", "random", "NDArray", "initializer", "init", "gluon",
     "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
     "io", "recordio", "image", "parallel", "profiler", "symbol", "sym",
-    "executor", "model", "module", "mod", "callback",
+    "executor", "model", "module", "mod", "callback", "contrib",
 ]

@@ -1,0 +1,5 @@
+"""``mx.contrib``: experimental / auxiliary subsystems (reference
+``python/mxnet/contrib/``)."""
+from . import amp  # noqa: F401
+
+__all__ = ["amp"]

@@ -1,0 +1,278 @@
+"""Automatic mixed precision (reference ``contrib/amp/amp.py``).
+
+Same op-list-driven design as the reference (``init`` :250 wraps every op
+invocation to cast inputs; ``init_trainer`` :287 attaches dynamic loss
+scaling; ``convert_model`` :508 / ``convert_hybrid_block`` :589 rewrite
+graphs/blocks for inference) — but bf16-first: on TPU the MXU natively
+consumes bfloat16, whose fp32-sized exponent makes loss scaling
+unnecessary, so the scaler only activates for float16 parity.
+
+Runtime mechanism: instead of monkeypatching generated wrappers like the
+reference, ``init`` installs one cast policy consulted by the ``mx.nd``
+dispatch layer (ops/registry.set_cast_policy) — it applies identically to
+eager ops, gluon forwards, and hybridized traces (the casts are traced
+into the jitted program where XLA fuses them into the matmuls).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import warnings
+
+import numpy as onp
+
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_symbol", "convert_hybrid_block",
+           "list_bf16_ops", "list_fp16_ops"]
+
+
+class _AmpState:
+    def __init__(self):
+        self.initialized = False
+        self.target_dtype = "bfloat16"
+        self.target_ops = set()
+        self.fp32_ops = set()
+        self.widest_ops = set()
+        self.conditional = []
+
+
+_STATE = _AmpState()
+
+
+def _widest(dtypes):
+    order = {"float16": 0, "bfloat16": 0, "float32": 1, "float64": 2}
+    best = None
+    for d in dtypes:
+        s = str(d)
+        if s in order and (best is None or order[s] > order[best]):
+            best = s
+    return best
+
+
+class _Policy:
+    """policy(op_name, dtypes) -> cast target or None."""
+
+    def __init__(self, state):
+        self._s = state
+
+    def __call__(self, op_name, dtypes, attrs=None):
+        s = self._s
+        for cop, carg, cvals in s.conditional:
+            if op_name == cop and attrs is not None \
+                    and attrs.get(carg) in cvals:
+                return "float32"
+        if op_name in s.target_ops:
+            return s.target_dtype
+        if op_name in s.fp32_ops:
+            return "float32"
+        if op_name in s.widest_ops:
+            ds = {str(d) for d in dtypes
+                  if str(d) in ("float16", "bfloat16", "float32",
+                                "float64")}
+            if len(ds) > 1:
+                return _widest(dtypes)
+        return None
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP process-wide (reference amp.py:250).
+
+    ``target_dtype`` is ``bfloat16`` (TPU-native) or ``float16``
+    (reference parity)."""
+    from ...ops import registry
+    target_dtype = str(onp.dtype(target_dtype)) \
+        if target_dtype not in ("bfloat16",) else "bfloat16"
+    assert target_dtype in ("bfloat16", "float16"), target_dtype
+    if _STATE.initialized:
+        warnings.warn("amp.init() called twice; reinitializing")
+    _STATE.target_dtype = target_dtype
+    _STATE.target_ops = set(target_precision_ops
+                            if target_precision_ops is not None
+                            else lists.TARGET_DTYPE_OPS)
+    _STATE.fp32_ops = set(fp32_ops if fp32_ops is not None
+                          else lists.FP32_OPS)
+    _STATE.widest_ops = set(lists.WIDEST_TYPE_CASTS)
+    _STATE.conditional = list(conditional_fp32_ops
+                              if conditional_fp32_ops is not None
+                              else lists.CONDITIONAL_FP32_OPS)
+    registry.set_cast_policy(_Policy(_STATE))
+    _STATE.initialized = True
+    logging.info("AMP initialized (target dtype %s)", target_dtype)
+
+
+def is_initialized():
+    return _STATE.initialized
+
+
+def disable():
+    """Uninstall the cast policy (testing convenience; no reference
+    analogue — the reference cannot un-patch)."""
+    from ...ops import registry
+    registry.set_cast_policy(None)
+    _STATE.initialized = False
+
+
+def init_trainer(trainer):
+    """Attach dynamic loss scaling to a Gluon Trainer (reference
+    amp.py:287).  bf16 needs no scaling, so the scaler starts at 1 and
+    never grows; fp16 gets the reference's dynamic scaler."""
+    assert _STATE.initialized, "call amp.init() before init_trainer()"
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        return
+    if _STATE.target_dtype != "float16":
+        # bf16 has fp32's exponent range: no scaling, no overflow check —
+        # install an inert scaler so scale_loss/unscale are no-ops
+        trainer._amp_loss_scaler = LossScaler(init_scale=1.0,
+                                              scale_factor=1.0,
+                                              scale_window=1 << 62)
+        trainer._amp_original_scale = trainer._scale
+        return
+    scaler = LossScaler()
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+    orig_step = trainer.step
+
+    def _amp_step(batch_size, ignore_stale_grad=False):
+        # overflow check gates the whole step (covers the fused-kvstore,
+        # kvstore and local update paths alike); the reference checks
+        # inside the update loop via multi_all_finite
+        grads = [p.grad() for p in trainer._params
+                 if p.grad_req != "null"]
+        overflow = scaler.has_overflow(grads)
+        if not overflow:
+            orig_step(batch_size, ignore_stale_grad)
+        scaler.update_scale(overflow)
+
+    trainer.step = _amp_step
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss and arrange for gradient unscaling in
+    ``trainer.step`` (reference amp.py scale_loss).
+
+    Like the reference, enter this inside ``autograd.record()`` (the
+    scaling multiply must be recorded) and call ``backward`` on the
+    yielded loss within the block."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    if scaler.loss_scale == 1.0:
+        # restore _scale in case a previous iteration lowered it
+        trainer._scale = trainer._amp_original_scale
+        yield loss
+        return
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide current gradients by the loss scale (reference amp.py
+    unscale) — for gradient clipping between backward and step."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    for p in trainer._params:
+        if p.grad_req != "null":
+            g = p.grad()
+            g[:] = g / scaler.loss_scale
+    # grads are now unscaled; step() must not divide by the scale again
+    trainer._scale = trainer._amp_original_scale
+
+
+# -- graph conversion --------------------------------------------------------
+
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, conditional_fp32_ops=None,
+                   excluded_sym_names=None, data_names=None,
+                   cast_optional_params=False):
+    """Insert ``amp_cast`` nodes around target/fp32 ops (reference
+    amp.py convert_symbol → C++ ReducePrecision pass)."""
+    from ...symbol.symbol import Symbol, _SymNode
+    target_ops = set(target_dtype_ops if target_dtype_ops is not None
+                     else lists.TARGET_DTYPE_OPS)
+    f32_ops = set(fp32_ops if fp32_ops is not None else lists.FP32_OPS)
+    excluded = set(excluded_sym_names or [])
+
+    mapping = {}
+
+    def cast_entry(entry, dtype, hint, slot):
+        node = _SymNode("amp_cast",
+                        "%s_%s_amp_cast_%s" % (hint, slot, dtype),
+                        {"dtype": dtype}, [entry], in_names=["data"])
+        return (node, 0)
+
+    new_nodes = []
+    for node in Symbol(sym._entries)._topo():
+        if node.op is None:
+            mapping[id(node)] = node
+            new_nodes.append(node)
+            continue
+        inputs = [(mapping[id(c)], i) for c, i in node.inputs]
+        if node.name not in excluded:
+            slots = node.in_names or [str(i) for i in range(len(inputs))]
+            if node.op in target_ops:
+                inputs = [cast_entry(e, target_dtype, node.name, s)
+                          for e, s in zip(inputs, slots)]
+            elif node.op in f32_ops:
+                inputs = [cast_entry(e, "float32", node.name, s)
+                          for e, s in zip(inputs, slots)]
+        clone = _SymNode(node.op, node.name, dict(node.attrs), inputs,
+                         in_names=node.in_names)
+        mapping[id(node)] = clone
+        new_nodes.append(clone)
+    entries = [(mapping[id(n)], i) for n, i in sym._entries]
+    return Symbol(entries)
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=None,
+                  cast_optional_params=False):
+    """(reference amp.py:508) — returns (converted_sym, arg_params,
+    aux_params); params stay fp32 unless cast_optional_params."""
+    new_sym = convert_symbol(sym, target_dtype, target_dtype_ops, fp32_ops,
+                             conditional_fp32_ops, excluded_sym_names,
+                             cast_optional_params=cast_optional_params)
+    if cast_optional_params:
+        arg_params = {k: v.astype(target_dtype) for k, v in
+                      arg_params.items()}
+    return new_sym, dict(arg_params), dict(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16",
+                         target_dtype_ops=None, fp32_ops=None,
+                         conditional_fp32_ops=None, excluded_sym_names=None,
+                         ctx=None, cast_optional_params=False):
+    """(reference amp.py:589): cast the block's parameters and rely on the
+    runtime cast policy for op-level precision; re-hybridizes so the next
+    forward traces a fresh mixed-precision program."""
+    if not _STATE.initialized:
+        init(target_dtype=target_dtype,
+             target_precision_ops=target_dtype_ops, fp32_ops=fp32_ops,
+             conditional_fp32_ops=conditional_fp32_ops)
+    if cast_optional_params:
+        block.cast(target_dtype)
+    if hasattr(block, "hybridize"):
+        block.hybridize()
+    return block
+
+
+def list_bf16_ops():
+    return list(lists.TARGET_DTYPE_OPS)
+
+
+def list_fp16_ops():
+    return list(lists.TARGET_DTYPE_OPS)
+
+
+def list_fp32_ops():
+    return list(lists.FP32_OPS)

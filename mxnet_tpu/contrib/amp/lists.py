@@ -1,0 +1,50 @@
+"""AMP op lists (reference ``contrib/amp/lists/symbol.py``): which ops run
+in the low-precision target dtype, which must stay fp32, and which follow
+the widest input dtype.
+
+The reference curates ~hundreds of op names for cuDNN fp16; on TPU the
+policy is the same shape but bf16-first: matmul/conv ops feed the MXU in
+bf16, reductions/normalizations/softmax stay fp32 for accuracy, and
+elementwise glue follows its inputs (XLA fuses the casts away).
+"""
+
+# ops cast TO the target dtype (the FLOP-heavy MXU ops)
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "fully_connected",
+    "Convolution", "convolution", "Convolution_v1",
+    "Deconvolution", "deconvolution",
+    "dot", "batch_dot",
+    "linalg_gemm", "linalg_gemm2",
+    "RNN",
+]
+
+# ops forced to float32 (numerically sensitive)
+FP32_OPS = [
+    "softmax", "log_softmax", "softmin", "SoftmaxActivation",
+    "SoftmaxOutput", "softmax_output", "Softmax",
+    "softmax_cross_entropy",
+    "BatchNorm", "batch_norm", "BatchNorm_v1",
+    "LayerNorm", "layer_norm", "InstanceNorm", "GroupNorm", "LRN", "lrn",
+    "norm", "L2Normalization",
+    "exp", "log", "log2", "log10", "log1p", "expm1",
+    "power", "_power_scalar", "rsqrt", "rcbrt", "reciprocal",
+    "mean", "sum", "sum_axis", "nansum", "prod", "nanprod",
+    "erfinv", "gamma", "gammaln",
+    "LinearRegressionOutput", "MAERegressionOutput",
+    "LogisticRegressionOutput", "make_loss",
+]
+
+# multi-input ops that should promote to the widest input dtype
+WIDEST_TYPE_CASTS = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_power", "broadcast_maximum", "broadcast_minimum",
+    "broadcast_hypot", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "elemwise_div", "where", "maximum", "minimum",
+]
+
+# conditionally-fp32 ops: (op, arg, values) — the reference keeps e.g.
+# LeakyReLU(act_type='selu') in fp32
+CONDITIONAL_FP32_OPS = [
+    ("LeakyReLU", "act_type", ["selu"]),
+    ("Activation", "act_type", ["softrelu"]),
+]

@@ -1,0 +1,48 @@
+"""Dynamic loss scaling (reference ``contrib/amp/loss_scaler.py``).
+
+Needed for fp16 parity only — bf16 has fp32's exponent range, so on TPU
+the scaler defaults to a no-op unless the target dtype is float16 (the
+reference's LossScaler semantics are kept exactly: scale up every
+``scale_window`` clean steps, halve on overflow and skip the update).
+"""
+from __future__ import annotations
+
+import logging
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        # tolerance is accepted for reference API parity (skip-ratio
+        # warning threshold there); the dynamics here don't need it
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self._has_overflow = False
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (reference uses
+        multi_all_finite).  The per-grad reductions are stacked so there
+        is exactly ONE device→host sync per call."""
+        import jax.numpy as jnp
+        if not params:
+            self._has_overflow = False
+            return False
+        vals = [p._data if hasattr(p, "_data") else p for p in params]
+        finite = jnp.stack([jnp.isfinite(v).all() for v in vals]).all()
+        self._has_overflow = not bool(finite)
+        return self._has_overflow
+
+    def update_scale(self, overflow):
+        """(reference loss_scaler.py update_scale)"""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+            logging.info("AMP: overflow detected, lowering loss scale to "
+                         "%g", self.loss_scale)
+        else:
+            self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0

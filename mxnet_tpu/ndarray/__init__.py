@@ -7,7 +7,7 @@ module ``__getattr__`` resolving names against the op registry lazily.
 from __future__ import annotations
 
 from ..context import Context, current_context
-from ..ops.registry import get_op, list_ops
+from ..ops.registry import get_cast_policy, get_op, list_ops
 from .ndarray import (  # noqa: F401
     NDArray, array, empty, zeros, ones, full, arange, linspace, eye,
     concat, stack, add_n, split, waitall, invoke_fn, from_numpy, from_jax,
@@ -47,6 +47,18 @@ def _make_op_func(op):
         arrays = [args[i] for i in pos_idx]
         kw_keys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
         arrays += [kwargs[k] for k in kw_keys]
+        policy = get_cast_policy()
+        if policy is not None and arrays:
+            static_attrs = {k: v for k, v in kwargs.items()
+                            if not isinstance(v, NDArray)}
+            tgt = policy(op.name, [a.dtype for a in arrays], static_attrs)
+            if tgt is not None:
+                import numpy as _onp
+                arrays = [a.astype(tgt)
+                          if (_onp.issubdtype(a.dtype, _onp.floating)
+                              or str(a.dtype) == "bfloat16")
+                          and str(a.dtype) != str(tgt) else a
+                          for a in arrays]
         if op.needs_rng:
             from .. import random as _random
             key = _random.next_key()

@@ -80,5 +80,24 @@ def get_op(name: str) -> Optional[OpDef]:
     return _OPS.get(name)
 
 
+# -- AMP cast-policy hook ----------------------------------------------------
+# Installed by mxnet_tpu.contrib.amp.init(); consulted by the mx.nd dispatch
+# layer before each op call (the TPU analogue of the reference's wrapped op
+# invocations, contrib/amp/amp.py:250 _wrap_symbol_functions).
+_CAST_POLICY = None
+
+
+def set_cast_policy(policy) -> None:
+    """policy(op_name, input_dtypes, static_attrs) -> target dtype str or
+    None (static_attrs: the op's keyword attributes, for conditional
+    fp32 rules)."""
+    global _CAST_POLICY
+    _CAST_POLICY = policy
+
+
+def get_cast_policy():
+    return _CAST_POLICY
+
+
 def list_ops():
     return sorted(_OPS.keys())

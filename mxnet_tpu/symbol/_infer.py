@@ -151,6 +151,18 @@ _PARAM_RULES = {
 }
 
 
+_SHAPE_PRESERVING = ("amp_cast", "cast", "Cast", "BlockGrad", "block_grad",
+                     "identity", "_copy", "stop_gradient", "make_loss")
+
+
+def _through_casts(node):
+    """Resolve through shape-preserving unary wrappers to the underlying
+    variable node, or None if the path ends at an op."""
+    while node.op in _SHAPE_PRESERVING and len(node.inputs) == 1:
+        node = node.inputs[0][0]
+    return node if node.op is None else None
+
+
 def _abstract_out_shapes(node, in_shapes):
     """Output shapes via jax.eval_shape over the registered kernel."""
     from ._eval import eval_node
@@ -188,14 +200,18 @@ def infer_graph_shapes(symbol, known: Dict[str, Tuple[int, ...]],
                        for c, i in node.inputs]
             in_shapes = [shapes.get(k) for k in in_keys]
             # backward inference into default-less variable inputs
+            # (seen through shape-preserving wrappers like amp_cast)
             rule = _PARAM_RULES.get(node.op)
             if rule is not None and node.in_names:
                 derived = rule(node.attrs, node.in_names, in_shapes)
                 for (c, _), pname, cur in zip(node.inputs, node.in_names,
                                               in_shapes):
-                    if cur is None and c.op is None and pname in derived:
-                        shapes[c.name] = tuple(int(v) for v in
-                                               derived[pname])
+                    var = _through_casts(c)
+                    if cur is None and var is not None \
+                            and var.name not in shapes \
+                            and pname in derived:
+                        shapes[var.name] = tuple(int(v) for v in
+                                                 derived[pname])
                         changed = True
                 in_shapes = [shapes.get(k) for k in in_keys]
             # forward inference once every input is known

@@ -1,0 +1,125 @@
+"""AMP tests (reference tests/python/gpu/test_contrib_amp.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import amp
+
+
+@pytest.fixture
+def amp_off():
+    yield
+    amp.disable()
+
+
+def test_policy_casts_target_ops(amp_off):
+    amp.init(target_dtype="bfloat16")
+    a = mx.nd.ones((4, 8))
+    w = mx.nd.ones((3, 8))
+    out = mx.nd.FullyConnected(a, w, no_bias=True, num_hidden=3)
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_policy_keeps_fp32_ops(amp_off):
+    amp.init(target_dtype="bfloat16")
+    x = mx.nd.ones((4, 8)).astype("bfloat16")
+    out = mx.nd.softmax(x)
+    assert str(out.dtype) == "float32"
+
+
+def test_widest_type_promotion(amp_off):
+    amp.init(target_dtype="bfloat16")
+    a = mx.nd.ones((4,)).astype("bfloat16")
+    b = mx.nd.ones((4,))  # float32
+    out = mx.nd.broadcast_add(a, b)
+    assert str(out.dtype) == "float32"
+
+
+def test_amp_gluon_training_descends(amp_off):
+    from mxnet_tpu import gluon, autograd
+    amp.init(target_dtype="bfloat16")
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    loss_fn = gluon.loss.L2Loss()
+    rs = onp.random.RandomState(0)
+    X = rs.uniform(-1, 1, (64, 4)).astype(onp.float32)
+    Y = (X.sum(axis=1, keepdims=True) * 0.5).astype(onp.float32)
+    losses = []
+    for _ in range(30):
+        xb, yb = mx.nd.array(X), mx.nd.array(Y)
+        with autograd.record():
+            out = net(xb)
+            loss = loss_fn(out, yb)
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        trainer.step(64)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_loss_scaler_dynamics():
+    s = amp.LossScaler(init_scale=1024.0, scale_factor=2.0, scale_window=4)
+    inf_grad = mx.nd.array(onp.array([onp.inf, 1.0], onp.float32))
+    ok_grad = mx.nd.array(onp.array([1.0, 2.0], onp.float32))
+    assert s.has_overflow([inf_grad])
+    s.update_scale(True)
+    assert s.loss_scale == 512.0
+    for _ in range(4):
+        assert not s.has_overflow([ok_grad])
+        s.update_scale(False)
+    assert s.loss_scale == 1024.0
+
+
+def test_fp16_trainer_skips_update_on_overflow(amp_off):
+    from mxnet_tpu import gluon, autograd
+    amp.init(target_dtype="float16")
+    net = gluon.nn.Dense(1)
+    net.initialize(mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    x = mx.nd.ones((2, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    # poison the gradient with inf: update must be skipped, scale halved
+    w = [p for p in trainer._params if p.grad_req != "null"][0]
+    g = w.grad()
+    g[:] = onp.inf
+    before = w.data().asnumpy().copy()
+    scale0 = trainer._amp_loss_scaler.loss_scale
+    trainer.step(1)
+    after = w.data().asnumpy()
+    onp.testing.assert_allclose(before, after)
+    assert trainer._amp_loss_scaler.loss_scale == scale0 / 2
+
+
+def test_convert_symbol_inserts_casts(amp_off):
+    from mxnet_tpu import sym
+    net = sym.FullyConnected(sym.var("data"), num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    conv = amp.convert_symbol(net, target_dtype="bfloat16")
+    ops = [n.op for n in conv._topo() if n.op is not None]
+    assert "amp_cast" in ops
+    # executor runs and FC math is bf16 while output stays fp32 (softmax)
+    exe = conv.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    exe.arg_dict["fc_weight"][:] = onp.ones((4, 3), onp.float32)
+    exe.forward(is_train=False)
+    assert str(exe.outputs[0].dtype) == "float32"
+    onp.testing.assert_allclose(exe.outputs[0].asnumpy().sum(axis=1),
+                                onp.ones(2), rtol=1e-3)
+
+
+def test_convert_model_casts_params(amp_off):
+    from mxnet_tpu import sym
+    net = sym.FullyConnected(sym.var("data"), num_hidden=4, name="fc")
+    arg = {"fc_weight": mx.nd.ones((4, 3)), "fc_bias": mx.nd.zeros((4,))}
+    s2, a2, x2 = amp.convert_model(net, arg, {},
+                                   target_dtype="bfloat16",
+                                   cast_optional_params=True)
+    assert str(a2["fc_weight"].dtype) == "bfloat16"
