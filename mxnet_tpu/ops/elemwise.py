@@ -112,7 +112,8 @@ _BINARY = {
     "minimum": jnp.minimum,
     "hypot": jnp.hypot,
     "arctan2": jnp.arctan2,
-    "ldexp": jnp.ldexp,
+    # MXNet ldexp takes a float exponent (lhs * 2^rhs); jnp.ldexp wants int
+    "ldexp": lambda a, b: a * jnp.power(2.0, b),
     "power": jnp.power,
     "mod": jnp.mod,
 }

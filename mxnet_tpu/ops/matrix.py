@@ -178,12 +178,13 @@ def amp_cast(data, dtype="float32"):
 
 @register("shape_array", differentiable=False)
 def shape_array(data):
-    return jnp.array(data.shape, dtype=jnp.int64)
+    # reference emits int64; without jax x64 the widest int is int32
+    return jnp.array(data.shape, dtype=jnp.int32)
 
 
 @register("size_array", differentiable=False)
 def size_array(data):
-    return jnp.array([data.size], dtype=jnp.int64)
+    return jnp.array([data.size], dtype=jnp.int32)
 
 
 @register("zeros_like")
@@ -321,10 +322,15 @@ def linalg_trmm(A, B, transpose: bool = False, rightside: bool = False,
 def linalg_trsm(A, B, transpose: bool = False, rightside: bool = False,
                 lower: bool = True, alpha: float = 1.0):
     import jax.scipy.linalg as jsl
-    a = A
-    sol = jsl.solve_triangular(a, alpha * B, trans=1 if transpose else 0,
-                               lower=lower, left_side=not rightside)
-    return sol
+    if rightside:
+        # X A = B  <=>  Aᵀ Xᵀ = Bᵀ — flip the trans flag instead of
+        # materializing Aᵀ
+        sol = jsl.solve_triangular(
+            A, jnp.swapaxes(alpha * B, -1, -2),
+            trans=0 if transpose else 1, lower=lower)
+        return jnp.swapaxes(sol, -1, -2)
+    return jsl.solve_triangular(A, alpha * B,
+                                trans=1 if transpose else 0, lower=lower)
 
 
 @register("linalg_sumlogdiag")
