@@ -42,6 +42,11 @@ from . import module
 from . import module as mod
 from . import callback
 from . import contrib
+from . import monitor
+from . import visualization
+from . import visualization as viz
+from . import runtime
+from . import engine
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray
@@ -53,4 +58,5 @@ __all__ = [
     "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
     "io", "recordio", "image", "parallel", "profiler", "symbol", "sym",
     "executor", "model", "module", "mod", "callback", "contrib",
+    "monitor", "visualization", "viz", "runtime", "engine",
 ]

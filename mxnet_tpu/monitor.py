@@ -1,0 +1,114 @@
+"""Monitor: periodic tensor statistics during training (reference
+``python/mxnet/monitor.py``).
+
+The reference taps every op's outputs via executor monitor callbacks
+(``MXExecutorSetMonitorCallback``).  Under XLA ops fuse into one program,
+so per-op taps don't exist; the TPU-native equivalent inspects the
+observable state after each step — arguments, auxiliary states, gradients
+and outputs of the installed executors — which covers the reference's
+standard use (weight/grad/output drift every N batches).
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """(reference monitor.py Monitor)
+
+    Parameters
+    ----------
+    interval : int — stats every ``interval`` calls to ``tic``.
+    stat_func : callable NDArray→NDArray, default mean(abs(x)).
+    pattern : regex on tensor names.
+    sort : sort output by name.
+    monitor_all : include arguments/gradients, not just outputs.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=True):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean() if hasattr(x, "abs") else x
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self._targets = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+
+    def install(self, target):
+        """Attach to a Module or Executor (reference install_to_executor).
+
+        Modules are stored by reference and resolved at ``toc`` time, so a
+        monitor installed before ``bind`` or across a batch-size reshape
+        (which swaps the Module's executor) stays live."""
+        if target not in self._targets:
+            self._targets.append(target)
+
+    def _live_exes(self):
+        for t in self._targets:
+            exe = getattr(t, "_exec", t)
+            if exe is not None:
+                yield exe
+
+    def tic(self):
+        """Start collecting for this batch if due (reference tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Collect stats; returns [(step, name, stat_str)] (reference
+        toc)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for exe in self._live_exes():
+            seen = set()
+
+            def visit(name, arr):
+                if arr is None or id(arr) in seen:
+                    return
+                seen.add(id(arr))
+                if not self.re_prog.match(name):
+                    return
+                self.queue.append((self.step - 1, name,
+                                   self.stat_func(arr)))
+            for name, out in zip(exe._symbol.list_outputs()
+                                 if hasattr(exe, "_symbol") else [],
+                                 exe.outputs):
+                visit(name, out)
+            if self.monitor_all:
+                for name, arr in getattr(exe, "arg_dict", {}).items():
+                    visit(name, arr)
+                for name, arr in getattr(exe, "grad_dict", {}).items():
+                    if arr is not None:
+                        visit(name + "_grad", arr)
+                for name, arr in getattr(exe, "aux_dict", {}).items():
+                    visit(name, arr)
+        for n, k, v_ in self.queue:
+            if isinstance(v_, NDArray):
+                v_ = v_.asnumpy()
+            res.append((n, k, str(v_)))
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log (reference toc_print)."""
+        res = self.toc()
+        for n, k, v_ in res:
+            logging.info("Batch: %7d %30s %s", n, k, v_)
+        return res

@@ -1,0 +1,88 @@
+"""Runtime feature detection (reference ``python/mxnet/runtime.py`` —
+``Features``/``feature_list`` over ``src/libinfo.cc`` compile-time flags).
+
+The reference's flags describe its CUDA/MKL build matrix; here features
+report what the JAX/XLA runtime actually provides on this host, so scripts
+doing ``mx.runtime.Features()['TPU'].is_enabled`` can branch the same way
+reference scripts branch on ``CUDA``.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+class _F(Feature):
+    @property
+    def is_enabled(self):
+        return self.enabled
+
+    def __repr__(self):
+        return "%s %s" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    import jax
+    feats = {}
+
+    def add(name, enabled):
+        feats[name] = _F(name, bool(enabled))
+
+    platforms, ndev = set(), 0
+    try:
+        devs = jax.devices()
+        platforms = {d.platform.lower() for d in devs}
+        ndev = len(devs)
+    except Exception:
+        pass
+    gpu_like = {"gpu", "cuda", "rocm"}
+    add("TPU", bool(platforms - {"cpu"} - gpu_like))
+    add("CUDA", bool(platforms & gpu_like))
+    add("CPU", True)
+    add("BF16", True)                      # MXU-native
+    add("F16C", True)                      # fp16 storage supported by XLA
+    add("INT64_TENSOR_SIZE", False)        # x64 disabled by default
+    add("DIST_KVSTORE", True)              # jax.distributed + collectives
+    add("SIGNAL_HANDLER", False)
+    add("DEBUG", False)
+    add("OPENCV", False)                   # pure-python image path
+    add("MKLDNN", False)
+    add("CUDNN", False)
+    add("NCCL", False)                     # ICI collectives instead
+    add("TENSORRT", False)
+    add("BLAS_OPEN", True)                 # via XLA's cpu backend
+    add("LAPACK", True)
+    add("JIT", True)                       # XLA compilation
+    add("MULTI_DEVICE", ndev > 1)
+    return feats
+
+
+class Features(collections.OrderedDict):
+    """Map of feature name → Feature (reference runtime.py:72)."""
+
+    instance = None
+
+    def __new__(cls):
+        if cls.instance is None:
+            cls.instance = super().__new__(cls)
+            cls.instance.update(_detect())
+        return cls.instance
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature '%s' is unknown, known features "
+                               "are: %s" % (feature_name,
+                                            list(self.keys())))
+        return self[feature_name].enabled
+
+
+def feature_list():
+    """(reference runtime.py:57)"""
+    return list(Features().values())

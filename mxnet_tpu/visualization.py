@@ -1,0 +1,119 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``):
+``print_summary`` (layer table with shapes/params) and ``plot_network``
+(graphviz, optional dependency).
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a Keras-style layer table (reference visualization.py:33).
+
+    ``shape`` — dict of input name → shape enabling output-shape and
+    parameter counting via the Symbol shape-inference pass.
+    """
+    if positions is None:
+        positions = [0.44, 0.64, 0.74, 1.0]
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    shape_dict = {}
+    if shape is not None:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape_partial(**shape)
+        for name, s in zip(interals.list_outputs(), out_shapes):
+            shape_dict[name] = s
+
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for f, p in zip(fields, pos):
+            line += str(f)
+            line = line[:p - 1]
+            line += " " * (p - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+    arg_names = set(symbol.list_arguments())
+    data_like = {n for n in arg_names
+                 if not (n.endswith("weight") or n.endswith("bias")
+                         or n.endswith("gamma") or n.endswith("beta"))}
+
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        out_name = name + "_output"
+        out_shape = shape_dict.get(out_name, "")
+        cur_param = 0
+        for in_idx, _, _ in node["inputs"]:
+            in_node = nodes[in_idx]
+            if in_node["op"] == "null" and in_node["name"] not in data_like:
+                s = shape_dict.get(in_node["name"] + "_output")
+                if s is None:
+                    s = shape_dict.get(in_node["name"])
+                if s:
+                    p = 1
+                    for d in s:
+                        p *= d
+                    cur_param += p
+        total_params += cur_param
+        pred = ", ".join(nodes[j]["name"] for j, _, _ in node["inputs"]
+                         if nodes[j]["op"] != "null"
+                         or nodes[j]["name"] in data_like)
+        print_row(["%s (%s)" % (name, op), str(out_shape), str(cur_param),
+                   pred], positions)
+        print("_" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz plot of the symbol DAG (reference visualization.py:214).
+    Requires the optional ``graphviz`` package.  ``node_attrs`` are merged
+    into every op node's style; ``shape``/``dtype`` are accepted for
+    reference API parity (edge shape labels are not rendered)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError(
+            "plot_network requires the 'graphviz' python package (not "
+            "bundled); use print_summary for a text view")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title, format=save_format)
+    attrs = dict(node_attrs or {})
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and (name.endswith("weight")
+                                 or name.endswith("bias")
+                                 or name.endswith("gamma")
+                                 or name.endswith("beta")
+                                 or "moving_" in name):
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op),
+                     **{"shape": "box", **attrs})
+        for in_idx, _, _ in node.get("inputs", []):
+            in_node = nodes[in_idx]
+            if in_node["op"] == "null" and hide_weights and (
+                    in_node["name"].endswith(("weight", "bias", "gamma",
+                                              "beta"))
+                    or "moving_" in in_node["name"]):
+                continue
+            dot.edge(tail_name=in_node["name"], head_name=name)
+    return dot
