@@ -63,8 +63,28 @@ class KVStore:
             self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense emulation: sparse storage is out of scope on TPU (SURVEY §2.2)
-        return self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows (reference kvstore.py:268).
+
+        Storage is dense on TPU (ndarray/sparse.py facade), but the
+        *contract* is honored: with ``row_ids`` given, rows outside the
+        request come back zero, exactly like the reference's row_sparse
+        pull — not a silent dense pull."""
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        from .ndarray import sparse as _sparse
+        self.pull(key, out=out, priority=priority)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        rids = row_ids if isinstance(row_ids, (list, tuple)) \
+            else [row_ids] * len(outs)
+        if len(rids) != len(outs):
+            raise MXNetError(
+                "row_sparse_pull: len(row_ids)=%d must match len(out)=%d"
+                % (len(rids), len(outs)))
+        for o, rid in zip(outs, rids):
+            kept = _sparse.retain(
+                _sparse.cast_storage(o, "row_sparse"), rid)
+            o._data = kept._data
+        return out
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -94,7 +94,9 @@ class NDArray:
 
     @property
     def stype(self) -> str:
-        return "default"  # dense; sparse storage is emulated (SURVEY §2.2 row 4)
+        """Dense; the sparse facades in ndarray/sparse.py override
+        (storage itself is emulated dense — SURVEY §2.2 row 4)."""
+        return "default"
 
     @property
     def T(self) -> "NDArray":
@@ -391,9 +393,11 @@ class NDArray:
         return invoke_fn(jnp.ones_like, [self], name="ones_like")
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("sparse storage types are not supported on TPU build yet")
-        return self
+        """Convert storage type (reference ndarray.py:393 tostype) —
+        returns a sparse-facade view for 'row_sparse'/'csr' (values stay
+        dense on TPU; see ndarray/sparse.py)."""
+        from . import sparse as _sparse
+        return _sparse.cast_storage(self, stype)
 
     def tojson(self):
         raise AttributeError("tojson is a Symbol method")

@@ -1,0 +1,237 @@
+"""Sparse NDArray facade: RowSparseNDArray and CSRNDArray.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` over the C++ storage types
+``kRowSparseStorage``/``kCSRStorage`` (``include/mxnet/ndarray.h:63-65``,
+aux TBlobs at ``ndarray.h:291``).
+
+TPU-native design — an *explicit, tested emulation* (SURVEY.md §2.2
+"dense + emulated"): the TPU has no sparse kernels and XLA computes
+dense, so values are STORED dense (every NDArray op works unchanged) while
+the sparse view — indices/indptr/data in the reference's exact layouts —
+is materialized on demand from the dense buffer.  What the reference's
+sparse types deliver functionally is preserved: the construction
+APIs (``csr_matrix``/``row_sparse_array``), the component accessors, stype
+round-trips (``tostype``/``cast_storage``), ``retain``, sparse-aware
+``dot``, and kvstore ``row_sparse_pull``.  What is NOT preserved is the
+memory saving — documented loudly here and in README rather than silently.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as onp
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context
+from .ndarray import NDArray, array as _dense_array, invoke_fn, _wrap
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "csr_matrix", "row_sparse_array", "array", "zeros", "empty",
+           "retain", "cast_storage", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base (reference sparse.py BaseSparseNDArray)."""
+
+    _stype = "default"
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def asnumpy(self):
+        return super().asnumpy()
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__,
+                                "x".join(str(s) for s in self.shape),
+                                self.context)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: a subset of rows is non-zero (reference
+    sparse.py:560).  ``indices`` — sorted non-zero row ids; ``data`` —
+    the dense values of those rows."""
+
+    _stype = "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        flat = onp.asarray(self.asnumpy()).reshape(self.shape[0], -1)
+        nz = onp.nonzero(onp.any(flat != 0, axis=1))[0]
+        return _dense_array(nz.astype(onp.int32), ctx=self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        idx = onp.asarray(self.indices.asnumpy(), dtype=onp.int32)
+        return _wrap(self._data[idx], self._ctx)
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        """Keep only the given rows (reference sparse_retain op)."""
+        return retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row (reference sparse.py:880): ``indptr`` (n+1),
+    ``indices`` (column ids), ``data`` (non-zero values)."""
+
+    _stype = "csr"
+
+    def _csr_components(self):
+        # computed once per underlying buffer (all three accessors share
+        # one host sync + scan)
+        key = id(self._data)
+        cached = getattr(self, "_csr_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        dense = onp.asarray(self.asnumpy())
+        indptr = [0]
+        indices = []
+        data = []
+        for row in dense:
+            nz = onp.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            data.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        comps = (onp.array(data, dense.dtype),
+                 onp.array(indices, onp.int32),
+                 onp.array(indptr, onp.int32))
+        self._csr_cache = (key, comps)
+        return comps
+
+    @property
+    def data(self) -> NDArray:
+        return _dense_array(self._csr_components()[0], ctx=self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return _dense_array(self._csr_components()[1], ctx=self._ctx)
+
+    @property
+    def indptr(self) -> NDArray:
+        return _dense_array(self._csr_components()[2], ctx=self._ctx)
+
+
+def _as_sparse(nd_arr: NDArray, cls) -> NDArray:
+    out = cls(nd_arr._data, ctx=nd_arr._ctx)
+    out._ag = nd_arr._ag  # stype change is a view: keep the tape link
+    return out
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference sparse.py csr_matrix).
+
+    ``csr_matrix((data, indices, indptr), shape=(M, N))`` or
+    ``csr_matrix(dense_source)``."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = [onp.asarray(
+            a.asnumpy() if isinstance(a, NDArray) else a) for a in arg1]
+        if shape is None:
+            raise MXNetError("csr_matrix from components requires shape")
+        dense = onp.zeros(shape, dtype or data.dtype)
+        for i in range(shape[0]):
+            lo, hi = int(indptr[i]), int(indptr[i + 1])
+            dense[i, indices[lo:hi].astype(int)] = data[lo:hi]
+        return _as_sparse(_dense_array(dense, ctx=ctx), CSRNDArray)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    if dtype is not None:
+        src = src.astype(dtype)
+    return _as_sparse(_dense_array(src, ctx=ctx), CSRNDArray)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference sparse.py row_sparse_array).
+
+    ``row_sparse_array((data, indices), shape=(M, ...))`` or a dense
+    source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2 \
+            and not onp.isscalar(arg1[0]):
+        data, indices = [onp.asarray(
+            a.asnumpy() if isinstance(a, NDArray) else a) for a in arg1]
+        if shape is None:
+            raise MXNetError("row_sparse_array from components requires "
+                             "shape")
+        dense = onp.zeros(shape, dtype or data.dtype)
+        dense[indices.astype(int)] = data
+        return _as_sparse(_dense_array(dense, ctx=ctx), RowSparseNDArray)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    if dtype is not None:
+        src = src.astype(dtype)
+    return _as_sparse(_dense_array(src, ctx=ctx), RowSparseNDArray)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-preserving nd.sparse.array (reference sparse.py array)."""
+    if isinstance(source_array, BaseSparseNDArray):
+        out = _as_sparse(_dense_array(source_array.asnumpy(), ctx=ctx,
+                                      dtype=dtype), type(source_array))
+        return out
+    raise MXNetError("nd.sparse.array expects a sparse NDArray; use "
+                     "csr_matrix/row_sparse_array to construct one")
+
+
+_STYPE_CLS = {"row_sparse": RowSparseNDArray, "csr": CSRNDArray,
+              "default": NDArray}
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """(reference sparse.py zeros)"""
+    from . import zeros as dense_zeros
+    base = dense_zeros(shape, ctx=ctx, dtype=dtype or "float32")
+    if stype == "default":
+        return base
+    if stype not in _STYPE_CLS:
+        raise MXNetError("unknown storage type %r" % stype)
+    return _as_sparse(base, _STYPE_CLS[stype])
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr: NDArray, stype: str) -> NDArray:
+    """Convert between storage types (reference cast_storage op,
+    src/operator/tensor/cast_storage*).  Values are preserved exactly;
+    only the facade class changes (storage is dense either way on TPU)."""
+    if stype not in _STYPE_CLS:
+        raise MXNetError("unknown storage type %r" % stype)
+    if stype == "default":
+        if type(arr) is NDArray:
+            return arr
+        out = NDArray(arr._data, ctx=arr._ctx)
+        out._ag = arr._ag
+        return out
+    return _as_sparse(arr, _STYPE_CLS[stype])
+
+
+def retain(arr: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """sparse_retain: zero out all rows except ``indices`` (reference
+    src/operator/tensor/sparse_retain-inl.h)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    idx = indices.asnumpy() if isinstance(indices, NDArray) \
+        else onp.asarray(indices)
+    idx = jnp.asarray(idx.astype(onp.int32))
+
+    def fn(x):
+        mask = jnp.zeros((x.shape[0],), dtype=bool).at[idx].set(True)
+        return jnp.where(mask.reshape((-1,) + (1,) * (x.ndim - 1)), x, 0)
+
+    out = invoke_fn(fn, [arr], name="sparse_retain")
+    return _as_sparse(out, RowSparseNDArray)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference sparse dot with CSR kernels,
+    src/operator/tensor/dot-inl.h): on TPU the MXU computes it dense —
+    XLA's dense matmul beats gather-based sparse kernels except at
+    extreme sparsity, which is exactly why the storage is emulated.
+    Differentiable: operands pass straight into the recorded dense dot."""
+    from . import __getattr__ as _nd_getattr
+    dense_dot = _nd_getattr("dot")
+    return dense_dot(lhs, rhs, transpose_a=transpose_a,
+                     transpose_b=transpose_b)

@@ -1,0 +1,86 @@
+"""Sparse NDArray facade tests (reference
+tests/python/unittest/test_sparse_ndarray.py, simplified to the emulated
+TPU semantics)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def test_csr_roundtrip():
+    data = onp.array([1., 2., 3., 4., 5.], "f")
+    indices = onp.array([0, 2, 2, 0, 1], "f")
+    indptr = onp.array([0, 2, 3, 5], "f")
+    a = sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    assert a.stype == "csr"
+    expect = onp.array([[1, 0, 2], [0, 0, 3], [4, 5, 0]], "f")
+    onp.testing.assert_allclose(a.asnumpy(), expect)
+    d, i, p = (a.data.asnumpy(), a.indices.asnumpy(), a.indptr.asnumpy())
+    onp.testing.assert_allclose(d, data)
+    onp.testing.assert_allclose(i, [0, 2, 2, 0, 1])
+    onp.testing.assert_allclose(p, [0, 2, 3, 5])
+
+
+def test_row_sparse_roundtrip():
+    data = onp.array([[1., 2.], [3., 4.]], "f")
+    indices = onp.array([1, 3], "f")
+    a = sparse.row_sparse_array((data, indices), shape=(4, 2))
+    assert a.stype == "row_sparse"
+    expect = onp.zeros((4, 2), "f")
+    expect[[1, 3]] = data
+    onp.testing.assert_allclose(a.asnumpy(), expect)
+    onp.testing.assert_allclose(a.indices.asnumpy(), [1, 3])
+    onp.testing.assert_allclose(a.data.asnumpy(), data)
+
+
+def test_tostype_and_cast_storage():
+    x = mx.nd.array(onp.array([[1., 0.], [0., 0.], [2., 3.]], "f"))
+    rs = x.tostype("row_sparse")
+    assert rs.stype == "row_sparse"
+    onp.testing.assert_allclose(rs.indices.asnumpy(), [0, 2])
+    back = rs.tostype("default")
+    assert back.stype == "default"
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+    csr = x.tostype("csr")
+    assert csr.stype == "csr"
+    onp.testing.assert_allclose(csr.asnumpy(), x.asnumpy())
+
+
+def test_retain():
+    x = mx.nd.array(onp.arange(12, dtype="f").reshape(4, 3) + 1)
+    rs = x.tostype("row_sparse")
+    kept = rs.retain(mx.nd.array(onp.array([0, 2], "f")))
+    out = kept.asnumpy()
+    assert (out[[0, 2]] != 0).all()
+    assert (out[[1, 3]] == 0).all()
+    onp.testing.assert_allclose(kept.indices.asnumpy(), [0, 2])
+
+
+def test_sparse_zeros_and_dot():
+    z = sparse.zeros("row_sparse", (3, 4))
+    assert z.stype == "row_sparse" and z.asnumpy().sum() == 0
+    a = sparse.csr_matrix(onp.array([[1., 0.], [0., 2.]], "f"))
+    b = mx.nd.array(onp.array([[1., 1.], [1., 1.]], "f"))
+    out = sparse.dot(a, b)
+    onp.testing.assert_allclose(out.asnumpy(), [[1., 1.], [2., 2.]])
+
+
+def test_sparse_ops_work_dense():
+    """Sparse facades participate in normal dense math (the emulation
+    contract)."""
+    a = sparse.row_sparse_array(
+        (onp.ones((1, 2), "f"), onp.array([1, ], "f")), shape=(3, 2))
+    out = (a * 2 + 1).asnumpy()
+    onp.testing.assert_allclose(out[1], [3., 3.])
+    onp.testing.assert_allclose(out[0], [1., 1.])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = mx.nd.array(onp.arange(8, dtype="f").reshape(4, 2) + 1)
+    kv.init("w", w)
+    out = mx.nd.zeros((4, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([0., 3.]))
+    got = out.asnumpy()
+    onp.testing.assert_allclose(got[[0, 3]], w.asnumpy()[[0, 3]])
+    onp.testing.assert_allclose(got[[1, 2]], onp.zeros((2, 2)))
