@@ -1,0 +1,98 @@
+"""Symbolic image-classification networks (reference
+``example/image-classification/symbols/{resnet,mlp}.py``).
+
+Built on the ``mx.sym`` API so the Module/`train_imagenet.py` path runs
+the same way reference scripts do; the graphs compile to single XLA
+programs via the Executor.
+"""
+from mxnet_tpu import symbol as sym
+
+
+def get_mlp(num_classes=10):
+    data = sym.var("data")
+    net = sym.Flatten(data)
+    net = sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc3")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _conv_bn_relu(data, num_filter, kernel, stride, pad, name,
+                  relu=True):
+    net = sym.Convolution(data, kernel=kernel, num_filter=num_filter,
+                          stride=stride, pad=pad, no_bias=True,
+                          name=name + "_conv")
+    net = sym.BatchNorm(net, fix_gamma=False, eps=2e-5, momentum=0.9,
+                        name=name + "_bn")
+    if relu:
+        net = sym.Activation(net, act_type="relu", name=name + "_relu")
+    return net
+
+
+def _residual_unit(data, num_filter, stride, dim_match, name,
+                   bottle_neck=True):
+    """One ResNet v1 unit (reference symbols/resnet.py residual_unit)."""
+    if bottle_neck:
+        body = _conv_bn_relu(data, num_filter // 4, (1, 1), (1, 1), (0, 0),
+                             name + "_c1")
+        body = _conv_bn_relu(body, num_filter // 4, (3, 3), stride, (1, 1),
+                             name + "_c2")
+        body = _conv_bn_relu(body, num_filter, (1, 1), (1, 1), (0, 0),
+                             name + "_c3", relu=False)
+    else:
+        body = _conv_bn_relu(data, num_filter, (3, 3), stride, (1, 1),
+                             name + "_c1")
+        body = _conv_bn_relu(body, num_filter, (3, 3), (1, 1), (1, 1),
+                             name + "_c2", relu=False)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv_bn_relu(data, num_filter, (1, 1), stride, (0, 0),
+                                 name + "_sc", relu=False)
+    return sym.Activation(body + shortcut, act_type="relu",
+                          name=name + "_out")
+
+
+_RESNET_CFG = {  # depth -> (bottleneck, units, filters)
+    18: (False, [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: (False, [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: (True, [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: (True, [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: (True, [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+}
+
+
+def get_resnet(depth=50, num_classes=1000, image_shape=(3, 224, 224)):
+    """ResNet v1 symbol (reference symbols/resnet.py resnet())."""
+    bottle_neck, units, filters = _RESNET_CFG[depth]
+    data = sym.var("data")
+    body = _conv_bn_relu(data, filters[0], (7, 7), (2, 2), (3, 3), "stem")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max", name="stem_pool")
+    for stage, n_units in enumerate(units):
+        for unit in range(n_units):
+            stride = (1, 1) if stage == 0 or unit > 0 else (2, 2)
+            # identity shortcut when channels and stride match; stage 1 of
+            # basic-block resnets (18/34) keeps 64 channels at stride 1
+            dim_match = unit > 0 or (
+                stage == 0 and filters[0] == filters[1])
+            body = _residual_unit(
+                body, filters[stage + 1], stride, dim_match=dim_match,
+                name="stage%d_unit%d" % (stage + 1, unit + 1),
+                bottle_neck=bottle_neck)
+    body = sym.Pooling(body, global_pool=True, pool_type="avg",
+                       kernel=(7, 7), name="global_pool")
+    body = sym.Flatten(body)
+    body = sym.FullyConnected(body, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(body, name="softmax")
+
+
+def get_symbol(network, num_classes, **kwargs):
+    if network == "mlp":
+        return get_mlp(num_classes)
+    if network.startswith("resnet"):
+        return get_resnet(int(network[len("resnet"):]), num_classes,
+                          **kwargs)
+    raise ValueError("unknown network %r" % network)

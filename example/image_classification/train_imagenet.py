@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Train an image-classification network through the Module path
+(reference ``example/image-classification/train_imagenet.py`` +
+``common/fit.py``).
+
+The north-star invocation shapes work unchanged:
+
+    python train_imagenet.py --network resnet50 --kv-store tpu \
+        --batch-size 64 --benchmark 1
+
+``--benchmark 1`` feeds synthetic data (reference fit.py --benchmark),
+which is also what the published perf numbers used
+(docs/faq/perf.md:239-241).  With --data-train pointing at a .rec file,
+ImageRecordIter-equivalent input (mx.image.ImageIter) is used.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from symbols import get_symbol  # noqa: E402
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Reference common/fit.py SyntheticDataIter: on-host random batch
+    served repeatedly (input pipeline excluded from the benchmark)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        super().__init__(batch_size=data_shape[0])
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        rs = onp.random.RandomState(99)
+        label = rs.randint(0, num_classes, (data_shape[0],))
+        self.data = mx.nd.array(
+            rs.uniform(-1, 1, data_shape).astype(dtype))
+        self.label = mx.nd.array(label.astype("float32"))
+        self._provide_data = [mx.io.DataDesc("data", data_shape)]
+        self._provide_label = [mx.io.DataDesc("softmax_label",
+                                              (data_shape[0],))]
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return mx.io.DataBatch(data=[self.data], label=[self.label],
+                               pad=0, index=None,
+                               provide_data=self._provide_data,
+                               provide_label=self._provide_label)
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_data(args):
+    image_shape = tuple(int(v) for v in args.image_shape.split(","))
+    if args.benchmark:
+        shape = (args.batch_size,) + image_shape
+        train = SyntheticDataIter(args.num_classes, shape,
+                                  args.num_batches, args.dtype)
+        return train, None
+    train = mx.image.ImageIter(
+        batch_size=args.batch_size, data_shape=image_shape,
+        path_imgrec=args.data_train, shuffle=True,
+        label_name="softmax_label")
+    val = None
+    if args.data_val:
+        val = mx.image.ImageIter(
+            batch_size=args.batch_size, data_shape=image_shape,
+            path_imgrec=args.data_val, label_name="softmax_label")
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train imagenet",
+                                     formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--network", default="resnet50")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--image-shape", default="3,224,224")
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--mom", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--optimizer", default="sgd")
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--benchmark", type=int, default=0)
+    parser.add_argument("--num-batches", type=int, default=40,
+                        help="batches per epoch in benchmark mode")
+    parser.add_argument("--data-train", default=None)
+    parser.add_argument("--data-val", default=None)
+    parser.add_argument("--disp-batches", type=int, default=10)
+    parser.add_argument("--model-prefix", default=None)
+    parser.add_argument("--amp", type=int, default=0,
+                        help="1 = bf16 mixed precision via contrib.amp")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    logging.info("args: %s", args)
+
+    if args.amp:
+        from mxnet_tpu.contrib import amp
+        amp.init(target_dtype="bfloat16")
+
+    image_shape = tuple(int(v) for v in args.image_shape.split(","))
+    net = get_symbol(args.network, args.num_classes,
+                     image_shape=image_shape)
+    devs = mx.tpu() if mx.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(net, context=devs)
+    train, val = get_data(args)
+
+    optimizer_params = {
+        "learning_rate": args.lr,
+        "wd": args.wd,
+        "rescale_grad": 1.0 / args.batch_size,
+    }
+    if args.optimizer in ("sgd", "nag"):
+        optimizer_params["momentum"] = args.mom
+
+    callbacks = [mx.callback.Speedometer(args.batch_size,
+                                         args.disp_batches)]
+    epoch_cb = None
+    if args.model_prefix:
+        epoch_cb = mx.callback.do_checkpoint(args.model_prefix)
+
+    tic = time.time()
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            kvstore=args.kv_store, optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            batch_end_callback=callbacks, epoch_end_callback=epoch_cb,
+            eval_metric=["acc"])
+    total = args.num_batches * args.num_epochs * args.batch_size
+    dt = time.time() - tic
+    if args.benchmark:
+        logging.info("benchmark: %.2f img/s overall (incl. compile)",
+                     total / dt)
+
+
+if __name__ == "__main__":
+    main()

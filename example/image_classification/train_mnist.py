@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Train an MLP on MNIST through the Module path (reference
+``example/image-classification/train_mnist.py``).
+
+Uses the gluon vision MNIST dataset when its files are available, else a
+synthetic separable task (so the script always runs offline).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from symbols import get_mlp  # noqa: E402
+
+
+def get_mnist_iters(batch_size):
+    try:
+        from mxnet_tpu.gluon.data.vision import MNIST
+        train = MNIST(train=True)
+        X = onp.stack([onp.asarray(train[i][0]).reshape(28 * 28)
+                       for i in range(len(train))]).astype("float32") / 255
+        Y = onp.array([train[i][1] for i in range(len(train))], "float32")
+    except Exception:
+        logging.warning("MNIST files unavailable; using synthetic data")
+        rs = onp.random.RandomState(0)
+        X = rs.uniform(0, 1, (4096, 784)).astype("float32")
+        W = rs.normal(0, 1, (784, 10)).astype("float32")
+        Y = (X @ W).argmax(axis=1).astype("float32")
+    n = int(len(X) * 0.9)
+    train_iter = mx.io.NDArrayIter(X[:n], Y[:n], batch_size, shuffle=True,
+                                   label_name="softmax_label")
+    val_iter = mx.io.NDArrayIter(X[n:], Y[n:], batch_size,
+                                 label_name="softmax_label")
+    return train_iter, val_iter
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--model-prefix", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    train, val = get_mnist_iters(args.batch_size)
+    devs = mx.tpu() if mx.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(get_mlp(10), context=devs)
+    epoch_cb = mx.callback.do_checkpoint(args.model_prefix) \
+        if args.model_prefix else None
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "rescale_grad": 1.0 / args.batch_size},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       100),
+            epoch_end_callback=epoch_cb)
+    score = mod.score(val, "acc")
+    logging.info("final validation accuracy: %s", score)
+
+
+if __name__ == "__main__":
+    main()

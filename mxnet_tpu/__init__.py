@@ -11,6 +11,15 @@ no hand-written kernels (XLA fusion + Pallas for hot spots), no ps-lite
 """
 from __future__ import annotations
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # honor the env var even when a jax plugin's register() overrides it
+    # (the axon TPU plugin does jax.config.update("jax_platforms", ...)
+    # at interpreter start, which would otherwise win over the env)
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 

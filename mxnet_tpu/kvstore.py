@@ -239,6 +239,26 @@ class KVStoreTPU(KVStoreLocal):
         waitall()
 
 
+def _maybe_init_distributed():
+    """Best-effort jax.distributed bootstrap from the tools/launch.py env
+    contract (MXNET_TPU_COORDINATOR_ADDRESS etc.) — the role the
+    reference's kvstore_dist plays when DMLC_ROLE is set."""
+    import os
+    import warnings
+    if "MXNET_TPU_COORDINATOR_ADDRESS" not in os.environ:
+        return
+    import jax
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return
+    try:
+        from . import parallel
+        parallel.initialize()
+    except Exception as e:  # backends may already be initialized
+        warnings.warn(
+            "dist kvstore: jax.distributed.initialize failed (%s); call "
+            "mx.parallel.initialize() before any jax computation" % e)
+
+
 def create(name="local") -> KVStore:
     """Create a KVStore (reference python/mxnet/kvstore.py create /
     KVStore::Create kvstore.cc).
@@ -254,6 +274,8 @@ def create(name="local") -> KVStore:
     if name_l in ("local", "local_allreduce_cpu", "local_allreduce_device", "device"):
         return KVStoreLocal(name_l)
     if name_l in ("tpu", "nccl", "dist_sync", "dist_device_sync", "dist", "horovod"):
+        if name_l.startswith("dist"):
+            _maybe_init_distributed()
         return KVStoreTPU(name_l)
     if name_l == "dist_async":
         raise MXNetError(

@@ -50,7 +50,19 @@ __all__ = [
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
     """Multi-host bootstrap (reference: ps-lite scheduler roles via
     DMLC_PS_ROOT_URI etc., docs/faq/distributed_training.md:254; here the
-    jax coordination service)."""
+    jax coordination service).
+
+    Arguments default from the env contract set by ``tools/launch.py``
+    (MXNET_TPU_COORDINATOR_ADDRESS / _NUM_PROCESSES / _PROCESS_ID), the
+    role the reference's DMLC_* env played."""
+    import os
+    if coordinator_address is None:
+        coordinator_address = os.environ.get(
+            "MXNET_TPU_COORDINATOR_ADDRESS")
+    if num_processes is None and "MXNET_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["MXNET_TPU_NUM_PROCESSES"])
+    if process_id is None and "MXNET_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["MXNET_TPU_PROCESS_ID"])
     kw = {}
     if coordinator_address is not None:
         kw["coordinator_address"] = coordinator_address

@@ -1,0 +1,104 @@
+"""Tools + example scripts (reference: tools/ and
+example/image-classification/ are exercised by CI scripts)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ,
+           JAX_PLATFORMS="cpu",
+           XLA_FLAGS="--xla_force_host_platform_device_count=8",
+           PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+
+def test_parse_log():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    lines = [
+        "INFO:root:Epoch[0] Batch [0-10]\tSpeed: 500.00 samples/sec",
+        "INFO:root:Epoch[0] Train-accuracy=0.5",
+        "INFO:root:Epoch[0] Time cost=3.2",
+        "INFO:root:Epoch[0] Validation-accuracy=0.6",
+        "INFO:root:Epoch[1] Train-accuracy=0.9",
+        "INFO:root:Epoch[1] Time cost=2.2",
+    ]
+    rows = parse_log.parse(lines)
+    assert rows[0]["train"]["accuracy"] == 0.5
+    assert rows[0]["val"]["accuracy"] == 0.6
+    assert rows[0]["speed"] == [500.0]
+    assert rows[1]["train"]["accuracy"] == 0.9 and rows[1]["val"] == {}
+    md = parse_log.render(rows)
+    assert md.startswith("| epoch |") and "| 1 |" in md
+
+
+def test_im2rec_roundtrip(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            img = (onp.random.RandomState(i).rand(8, 8, 3) * 255
+                   ).astype("uint8")
+            cv2.imwrite(str(root / cls / ("%d.png" % i)), img)
+    prefix = str(tmp_path / "pack")
+    script = os.path.join(REPO, "tools", "im2rec.py")
+    subprocess.run([sys.executable, script, prefix, str(root), "--list"],
+                   check=True, env=ENV)
+    assert os.path.exists(prefix + ".lst")
+    subprocess.run([sys.executable, script, prefix, str(root)],
+                   check=True, env=ENV)
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rec.keys) == 6
+    header, img = recordio.unpack_img(rec.read_idx(rec.keys[0]))
+    assert img.shape == (8, 8, 3)
+    assert header.label in (0.0, 1.0)
+
+
+def test_launch_local_spawns_ranked_workers(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import launch
+    out = tmp_path / "ranks"
+    out.mkdir()
+    cmd = [sys.executable, "-c",
+           "import os; open(os.path.join(%r, os.environ["
+           "'MXNET_TPU_PROCESS_ID']), 'w').write("
+           "os.environ['MXNET_TPU_COORDINATOR_ADDRESS'])" % str(out)]
+    codes = launch.launch_local(3, cmd, env=ENV)
+    assert codes == [0, 0, 0]
+    files = sorted(os.listdir(out))
+    assert files == ["0", "1", "2"]
+    addrs = {open(out / f).read() for f in files}
+    assert len(addrs) == 1  # same coordinator for all ranks
+
+
+def test_train_mnist_script_runs():
+    script = os.path.join(REPO, "example", "image_classification",
+                          "train_mnist.py")
+    res = subprocess.run(
+        [sys.executable, script, "--num-epochs", "2", "--batch-size",
+         "64"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "final validation accuracy" in res.stderr \
+        or "final validation accuracy" in res.stdout
+
+
+def test_train_imagenet_benchmark_smoke():
+    """tiny resnet18 on synthetic data — the north-star command shape."""
+    script = os.path.join(REPO, "example", "image_classification",
+                          "train_imagenet.py")
+    res = subprocess.run(
+        [sys.executable, script, "--network", "resnet18",
+         "--num-classes", "10", "--image-shape", "3,32,32",
+         "--batch-size", "8", "--benchmark", "1", "--num-batches", "3",
+         "--kv-store", "local", "--num-epochs", "1"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "benchmark:" in res.stderr or "benchmark:" in res.stdout

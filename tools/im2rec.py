@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Pack an image directory into RecordIO (reference ``tools/im2rec.py``).
+
+Two phases, same CLI shape as the reference:
+  --list: walk an image root, write a ``.lst`` file
+          (index \\t label \\t relpath per line, label = folder index).
+  (default): read a ``.lst`` file, encode each image and append it to
+          ``prefix.rec`` + ``prefix.idx`` via MXIndexedRecordIO.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(args):
+    image_list = []
+    label = 0
+    labels = {}
+    for root, dirs, files in os.walk(args.root, followlinks=True):
+        dirs.sort()
+        files.sort()
+        for f in files:
+            if os.path.splitext(f)[1].lower() in EXTS:
+                folder = os.path.relpath(root, args.root)
+                if folder not in labels:
+                    labels[folder] = label
+                    label += 1
+                image_list.append(
+                    (os.path.relpath(os.path.join(root, f), args.root),
+                     labels[folder]))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    fname = args.prefix + ".lst"
+    with open(fname, "w") as f:
+        for i, (path, lab) in enumerate(image_list):
+            f.write("%d\t%f\t%s\n" % (i, lab, path))
+    print("wrote %s (%d images, %d classes)" % (fname, len(image_list),
+                                                label))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(v) for v in parts[1:-1]], parts[-1]
+
+
+def pack_records(args):
+    import cv2
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(args.prefix + ".lst"):
+        path = os.path.join(args.root, rel)
+        img = cv2.imread(path, args.color)
+        if img is None:
+            print("skip unreadable %s" % path)
+            continue
+        if args.resize:
+            h, w = img.shape[:2]
+            scale = args.resize / min(h, w)
+            img = cv2.resize(img, (int(w * scale + 0.5),
+                                   int(h * scale + 0.5)))
+        if args.center_crop:
+            h, w = img.shape[:2]
+            s = min(h, w)
+            y0, x0 = (h - s) // 2, (w - s) // 2
+            img = img[y0:y0 + s, x0:x0 + s]
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        packed = recordio.pack_img(header, img, quality=args.quality,
+                                   img_fmt=args.encoding)
+        rec.write_idx(idx, packed)
+        count += 1
+    rec.close()
+    print("packed %d records into %s.rec" % (count, args.prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO pack (reference "
+                    "tools/im2rec.py)")
+    parser.add_argument("prefix", help="prefix of .lst/.rec/.idx")
+    parser.add_argument("root", help="image root dir")
+    parser.add_argument("--list", action="store_true",
+                        help="generate the .lst instead of packing")
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg",
+                        choices=[".jpg", ".png"])
+    parser.add_argument("--color", type=int, default=1,
+                        choices=[-1, 0, 1])
+    args = parser.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        pack_records(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference ``tools/parse_log.py``).
+
+Consumes the log lines the Module/callback stack emits::
+
+    INFO:root:Epoch[3] Train-accuracy=0.96
+    INFO:root:Epoch[3] Time cost=2.3
+    INFO:root:Epoch[3] Validation-accuracy=0.94
+
+and prints markdown (or tsv) with one row per epoch.
+"""
+import argparse
+import re
+import sys
+
+TRAIN_RE = re.compile(r"Epoch\[(\d+)\] Train-([\w-]+)=([\d.eE+-]+)")
+VAL_RE = re.compile(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)")
+TIME_RE = re.compile(r"Epoch\[(\d+)\] Time cost=([\d.eE+-]+)")
+SPEED_RE = re.compile(r"Epoch\[(\d+)\].*Speed: ([\d.eE+-]+) samples/sec")
+
+
+def parse(lines):
+    """rows[epoch] = {"train": {metric: v}, "val": {metric: v},
+    "time": float|None, "speed": [..]} — every metric name kept (fit can
+    emit several eval metrics per epoch)."""
+    rows = {}
+
+    def row(e):
+        return rows.setdefault(int(e), {"train": {}, "val": {},
+                                        "time": None, "speed": []})
+    for line in lines:
+        m = TRAIN_RE.search(line)
+        if m:
+            row(m.group(1))["train"][m.group(2)] = float(m.group(3))
+        m = VAL_RE.search(line)
+        if m:
+            row(m.group(1))["val"][m.group(2)] = float(m.group(3))
+        m = TIME_RE.search(line)
+        if m:
+            row(m.group(1))["time"] = float(m.group(2))
+        m = SPEED_RE.search(line)
+        if m:
+            row(m.group(1))["speed"].append(float(m.group(2)))
+    return rows
+
+
+def render(rows, fmt="markdown"):
+    train_metrics = sorted({k for r in rows.values() for k in r["train"]})
+    val_metrics = sorted({k for r in rows.values() for k in r["val"]})
+    header = (["epoch"] + ["train-%s" % m for m in train_metrics]
+              + ["val-%s" % m for m in val_metrics] + ["time", "speed"])
+    out = []
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    for e in sorted(rows):
+        r = rows[e]
+        speed = (sum(r["speed"]) / len(r["speed"])) if r["speed"] else None
+        cells = ([r["train"].get(m) for m in train_metrics]
+                 + [r["val"].get(m) for m in val_metrics]
+                 + [r["time"], speed])
+        vals = [str(e)] + ["%.6g" % v if v is not None else "-"
+                           for v in cells]
+        if fmt == "markdown":
+            out.append("| " + " | ".join(vals) + " |")
+        else:
+            out.append("\t".join(vals))
+    return "\n".join(out)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile", nargs="?", default="-")
+    parser.add_argument("--format", choices=["markdown", "tsv"],
+                        default="markdown")
+    args = parser.parse_args()
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    print(render(parse(lines), args.format))
+
+
+if __name__ == "__main__":
+    main()
