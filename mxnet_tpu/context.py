@@ -106,7 +106,11 @@ def _cpu_devices():
     try:
         return jax.devices("cpu")
     except RuntimeError:
-        return []
+        # Some deployments expose only the accelerator backend (no host-CPU
+        # platform registered).  cpu() then resolves to the default devices so
+        # default-context array creation still works; arrays simply live in
+        # HBM, which is semantically fine (XLA owns placement).
+        return jax.devices()
 
 
 def _accel_devices():
