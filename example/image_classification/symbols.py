@@ -65,12 +65,23 @@ _RESNET_CFG = {  # depth -> (bottleneck, units, filters)
 
 
 def get_resnet(depth=50, num_classes=1000, image_shape=(3, 224, 224)):
-    """ResNet v1 symbol (reference symbols/resnet.py resnet())."""
+    """ResNet v1 symbol (reference symbols/resnet.py resnet()).
+
+    Small inputs (height <= 32, e.g. CIFAR) get the 3x3/s1 stem without the
+    stem max-pool, like the reference's small-image branch, so the last
+    stages don't collapse to 1x1 feature maps.
+    """
     bottle_neck, units, filters = _RESNET_CFG[depth]
     data = sym.var("data")
-    body = _conv_bn_relu(data, filters[0], (7, 7), (2, 2), (3, 3), "stem")
-    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
-                       pool_type="max", name="stem_pool")
+    small_image = image_shape[-2] <= 32
+    if small_image:
+        body = _conv_bn_relu(data, filters[0], (3, 3), (1, 1), (1, 1),
+                             "stem")
+    else:
+        body = _conv_bn_relu(data, filters[0], (7, 7), (2, 2), (3, 3),
+                             "stem")
+        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           pool_type="max", name="stem_pool")
     for stage, n_units in enumerate(units):
         for unit in range(n_units):
             stride = (1, 1) if stage == 0 or unit > 0 else (2, 2)

@@ -113,6 +113,12 @@ def main():
     logging.basicConfig(level=logging.INFO)
     logging.info("args: %s", args)
 
+    # Under tools/launch.py the coordination service must be joined BEFORE
+    # any jax computation initializes the backends — kvstore.create's
+    # fallback inside mod.fit is too late by then.
+    if os.environ.get("MXNET_TPU_COORDINATOR_ADDRESS"):
+        mx.parallel.initialize()
+
     if args.amp:
         from mxnet_tpu.contrib import amp
         amp.init(target_dtype="bfloat16")

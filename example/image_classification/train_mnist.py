@@ -23,9 +23,11 @@ def get_mnist_iters(batch_size):
     try:
         from mxnet_tpu.gluon.data.vision import MNIST
         train = MNIST(train=True)
-        X = onp.stack([onp.asarray(train[i][0]).reshape(28 * 28)
-                       for i in range(len(train))]).astype("float32") / 255
-        Y = onp.array([train[i][1] for i in range(len(train))], "float32")
+        # read the dataset's whole-array storage once instead of 60k
+        # per-item __getitem__ device round-trips
+        X = onp.asarray(train._data.asnumpy(), "float32")
+        X = X.reshape(len(X), -1) / 255
+        Y = onp.asarray(train._label, "float32").reshape(-1)
     except Exception:
         logging.warning("MNIST files unavailable; using synthetic data")
         rs = onp.random.RandomState(0)
@@ -49,6 +51,11 @@ def main():
     parser.add_argument("--model-prefix", default=None)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+
+    # join the coordination service before any jax computation (see
+    # train_imagenet.py — kvstore.create's fallback is too late)
+    if os.environ.get("MXNET_TPU_COORDINATOR_ADDRESS"):
+        mx.parallel.initialize()
 
     train, val = get_mnist_iters(args.batch_size)
     devs = mx.tpu() if mx.num_tpus() else mx.cpu()

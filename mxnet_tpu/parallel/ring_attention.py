@@ -119,11 +119,14 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     l0 = jnp.zeros((B, H, T_local), jnp.float32)
     o0 = jnp.zeros((B, H, T_local, D), jnp.float32)
     # mark accumulators as device-varying along the ring axis so the scan
-    # carry type matches after the flash update (jax vma type system)
-    try:
+    # carry type matches after the flash update (jax vma type system);
+    # pvary is deprecated in favour of pcast(..., to='varying')
+    _pcast = getattr(lax, "pcast", None)
+    if _pcast is not None:
+        m0, l0, o0 = (_pcast(a, (axis_name,), to="varying")
+                      for a in (m0, l0, o0))
+    elif hasattr(lax, "pvary"):
         m0, l0, o0 = (lax.pvary(a, (axis_name,)) for a in (m0, l0, o0))
-    except AttributeError:
-        pass
 
     def body(carry, _):
         m, l, o, k_cur, v_cur, src = carry

@@ -28,6 +28,8 @@ import socket
 import subprocess
 import sys
 
+DEFAULT_PORT = 9462
+
 
 def _free_port():
     s = socket.socket()
@@ -62,9 +64,9 @@ def launch_local(n, command, env=None):
     return codes
 
 
-def launch_ssh(hosts, command, env_keys=("PYTHONPATH",)):
+def launch_ssh(hosts, command, env_keys=("PYTHONPATH",), port=DEFAULT_PORT):
     import shlex
-    coordinator = "%s:%d" % (hosts[0], 9462)
+    coordinator = "%s:%d" % (hosts[0], port)
     procs = []
     for rank, host in enumerate(hosts):
         env = _worker_env({}, coordinator, len(hosts), rank)
@@ -91,8 +93,14 @@ def main():
                         default="local")
     parser.add_argument("-H", "--hostfile", default=None,
                         help="one host per line (ssh launcher)")
+    parser.add_argument("-p", "--port", type=int, default=DEFAULT_PORT,
+                        help="coordination-service port on host 0 (ssh "
+                             "launcher); change when two jobs share a "
+                             "coordinator host")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
     if not args.command:
         parser.error("no command given")
     if args.launcher == "local":
@@ -101,7 +109,8 @@ def main():
         with open(args.hostfile) as f:
             hosts = [h.strip() for h in f if h.strip()]
         assert len(hosts) >= args.num_workers, "not enough hosts"
-        codes = launch_ssh(hosts[:args.num_workers], args.command)
+        codes = launch_ssh(hosts[:args.num_workers], args.command,
+                           port=args.port)
     bad = [c for c in codes if c != 0]
     if bad:
         sys.exit(bad[0])
