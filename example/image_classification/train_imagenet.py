@@ -112,12 +112,8 @@ def main():
 
     logging.basicConfig(level=logging.INFO)
     logging.info("args: %s", args)
-
-    # Under tools/launch.py the coordination service must be joined BEFORE
-    # any jax computation initializes the backends — kvstore.create's
-    # fallback inside mod.fit is too late by then.
-    if os.environ.get("MXNET_TPU_COORDINATOR_ADDRESS"):
-        mx.parallel.initialize()
+    # (under tools/launch.py, importing mxnet_tpu already joined the
+    # coordination service from the env contract)
 
     if args.amp:
         from mxnet_tpu.contrib import amp

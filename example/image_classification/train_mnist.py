@@ -52,11 +52,6 @@ def main():
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    # join the coordination service before any jax computation (see
-    # train_imagenet.py — kvstore.create's fallback is too late)
-    if os.environ.get("MXNET_TPU_COORDINATOR_ADDRESS"):
-        mx.parallel.initialize()
-
     train, val = get_mnist_iters(args.batch_size)
     devs = mx.tpu() if mx.num_tpus() else mx.cpu()
     mod = mx.mod.Module(get_mlp(10), context=devs)

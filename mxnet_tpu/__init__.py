@@ -20,6 +20,18 @@ if _os.environ.get("JAX_PLATFORMS"):
     import jax as _jax
     _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
+if _os.environ.get("MXNET_TPU_COORDINATOR_ADDRESS"):
+    # Launched by tools/launch.py: join the coordination service BEFORE any
+    # computation initializes the jax backends — by first import is the only
+    # reliably-early point, so the library owns this invariant rather than
+    # every entry-point script.
+    import jax.distributed as _jdist
+    if not _jdist.is_initialized():
+        _jdist.initialize(
+            coordinator_address=_os.environ["MXNET_TPU_COORDINATOR_ADDRESS"],
+            num_processes=int(_os.environ.get("MXNET_TPU_NUM_PROCESSES", 1)),
+            process_id=int(_os.environ.get("MXNET_TPU_PROCESS_ID", 0)))
+
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 

@@ -6,6 +6,8 @@ from . import loss  # noqa: F401
 from . import data  # noqa: F401
 from . import model_zoo  # noqa: F401
 from . import rnn  # noqa: F401
+# NOTE: gluon.contrib is an explicit opt-in import, like the reference
+# (``from mxnet_tpu.gluon import contrib``) — keeps base import light.
 from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .parameter import (  # noqa: F401
     Constant, DeferredInitializationError, Parameter, ParameterDict)

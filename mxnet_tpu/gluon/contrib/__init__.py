@@ -1,0 +1,7 @@
+"""Gluon contrib: experimental layers, cells, and training utilities
+(reference ``python/mxnet/gluon/contrib/``)."""
+from . import nn
+from . import cnn
+from . import rnn
+from . import data
+from . import estimator

@@ -1,0 +1,6 @@
+"""Contrib convolutional layers (reference
+``python/mxnet/gluon/contrib/cnn/``)."""
+from .conv_layers import *  # noqa: F401,F403
+from . import conv_layers
+
+__all__ = conv_layers.__all__

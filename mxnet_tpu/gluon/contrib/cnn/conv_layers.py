@@ -1,0 +1,98 @@
+"""Contrib conv layers (reference
+``python/mxnet/gluon/contrib/cnn/conv_layers.py`` DeformableConvolution)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import Activation
+
+__all__ = ["DeformableConvolution"]
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable Convolution v1 (Dai et al. 2017; reference
+    contrib/cnn/conv_layers.py over
+    src/operator/contrib/deformable_convolution-inl.h).
+
+    A regular conv predicts per-position sampling offsets, then the
+    deformable conv samples the input at (grid + offset) with bilinear
+    interpolation.  Both convs and the bilinear im2col compile into one
+    XLA program.
+    """
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 op_name="DeformableConvolution", adj=None,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout == "NCHW", "only NCHW is supported"
+        kernel_size = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        strides = (strides,) * 2 if isinstance(strides, int) \
+            else tuple(strides)
+        padding = (padding,) * 2 if isinstance(padding, int) \
+            else tuple(padding)
+        dilation = (dilation,) * 2 if isinstance(dilation, int) \
+            else tuple(dilation)
+        self._channels = channels
+        self._kwargs = {
+            "kernel": kernel_size, "stride": strides, "pad": padding,
+            "dilate": dilation, "num_filter": channels, "num_group": groups,
+            "num_deformable_group": num_deformable_group,
+            "no_bias": not use_bias}
+        offset_channels = 2 * kernel_size[0] * kernel_size[1] \
+            * num_deformable_group
+        self._offset_kwargs = {
+            "kernel": kernel_size, "stride": strides, "pad": padding,
+            "dilate": dilation, "num_filter": offset_channels,
+            "no_bias": not offset_use_bias}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight",
+                shape=(channels, in_channels // groups) + kernel_size,
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=bias_initializer,
+                allow_deferred_init=True) if use_bias else None
+            self.offset_weight = self.params.get(
+                "offset_weight",
+                shape=(offset_channels, in_channels) + kernel_size,
+                init=offset_weight_initializer, allow_deferred_init=True)
+            self.offset_bias = self.params.get(
+                "offset_bias", shape=(offset_channels,),
+                init=offset_bias_initializer,
+                allow_deferred_init=True) if offset_use_bias else None
+            self.act = Activation(activation) if activation else None
+
+    def infer_shape(self, x, *args):
+        in_c = x.shape[1]
+        k = self._kwargs["kernel"]
+        g = self._kwargs["num_group"]
+        self.weight._finish_deferred_init((self._channels, in_c // g) + k)
+        if self.bias is not None:
+            self.bias._finish_deferred_init((self._channels,))
+        oc = self._offset_kwargs["num_filter"]
+        self.offset_weight._finish_deferred_init((oc, in_c) + k)
+        if self.offset_bias is not None:
+            self.offset_bias._finish_deferred_init((oc,))
+
+    def hybrid_forward(self, F, x, weight, offset_weight, bias=None,
+                       offset_bias=None):
+        offset = F.Convolution(x, offset_weight, offset_bias,
+                               no_bias=offset_bias is None,
+                               **{k: v for k, v in
+                                  self._offset_kwargs.items()
+                                  if k != "no_bias"})
+        out = F._contrib_DeformableConvolution(x, offset, weight, bias,
+                                               **self._kwargs)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return "DeformableConvolution(channels=%d, kernel=%s)" % (
+            self._channels, (self._kwargs["kernel"],))

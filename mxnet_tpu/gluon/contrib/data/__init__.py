@@ -1,0 +1,6 @@
+"""Contrib datasets and samplers (reference
+``python/mxnet/gluon/contrib/data/``)."""
+from .sampler import *  # noqa: F401,F403
+from . import sampler
+
+__all__ = sampler.__all__

@@ -1,0 +1,7 @@
+"""Gluon Estimator: a declarative fit-loop abstraction (reference
+``python/mxnet/gluon/contrib/estimator/``)."""
+from .estimator import *  # noqa: F401,F403
+from .event_handler import *  # noqa: F401,F403
+from . import estimator, event_handler
+
+__all__ = estimator.__all__ + event_handler.__all__

@@ -1,0 +1,4 @@
+"""Contrib neural-network layers (reference
+``python/mxnet/gluon/contrib/nn/``)."""
+from .basic_layers import *  # noqa: F401,F403
+from .basic_layers import __all__  # noqa: F401

@@ -1,0 +1,173 @@
+"""Contrib layers (reference ``python/mxnet/gluon/contrib/nn/basic_layers.py``:
+Concurrent/HybridConcurrent :31,:64, Identity :97, SparseEmbedding :118,
+SyncBatchNorm :165, PixelShuffle1D/2D/3D :244-394).
+
+TPU notes: SyncBatchNorm's cross-device statistic exchange is a ``lax.pmean``
+over the data-parallel mesh axis inside the jitted step — the reference's
+hand-rolled all-reduce kernel (src/operator/contrib/sync_batch_norm-inl.h)
+collapses into one XLA collective.  PixelShuffle is pure reshape/transpose,
+which XLA folds into the surrounding layout assignment.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import Sequential, HybridSequential, BatchNorm, Embedding
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs along ``axis``
+    (reference contrib/nn/basic_layers.py:31)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference contrib/nn/basic_layers.py:64)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+    hybrid_forward = forward
+
+
+class Identity(HybridBlock):
+    """Pass-through block, useful in Concurrent branches
+    (reference contrib/nn/basic_layers.py:97)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row-sparse gradient in the reference
+    (contrib/nn/basic_layers.py:118).  On TPU gradients stay dense — XLA
+    scatter-add handles the update — so this is the dense Embedding with the
+    sparse-API name kept for compatibility (sparse facade rationale:
+    SURVEY.md §2.2 sparse row)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype, **kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference
+    contrib/nn/basic_layers.py:165 over
+    src/operator/contrib/sync_batch_norm-inl.h).
+
+    Inside a data-parallel jitted step (``parallel.DataParallelStep`` /
+    shard_map with a named ``key`` axis) the batch statistics are averaged
+    over the mesh axis before normalizing; standalone it behaves like
+    BatchNorm (ndev=1).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", key="dp", **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+        self._kwargs = {"eps": epsilon, "momentum": momentum,
+                        "fix_gamma": not scale,
+                        "use_global_stats": use_global_stats,
+                        "ndev": num_devices if num_devices else 1,
+                        "key": key}
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from .... import autograd
+        out, mean, var = F._contrib_SyncBatchNorm(
+            x, gamma, beta, running_mean, running_var,
+            name="fwd", **self._kwargs)
+        if autograd.is_training() and not self._kwargs["use_global_stats"]:
+            m = self._momentum
+            with autograd.pause():
+                self.running_mean.set_data(running_mean * m + mean * (1 - m))
+                self.running_var.set_data(running_var * m + var * (1 - m))
+        return out
+
+
+class _PixelShuffle(HybridBlock):
+    """Shared reshape/transpose machinery for PixelShuffle.
+
+    Reference contrib/nn/basic_layers.py:244-394 does this with three
+    reshape_like/transpose chains; here it is the direct
+    depth-to-space index permutation, one reshape + transpose + reshape
+    (pure layout op for XLA).
+    """
+
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            self._factors = (int(factor),) * ndim
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            assert len(self._factors) == ndim, \
+                "factor must be a scalar or one value per spatial dim"
+        self._ndim = ndim
+
+    def hybrid_forward(self, F, x):
+        import numpy as onp
+        f = self._factors
+        nd_ = self._ndim
+        n, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        c_out = c // int(onp.prod(f))
+        # (N, C*f1*..*fk, d1..dk) -> (N, C, f1..fk, d1..dk)
+        x = F.reshape(x, (n, c_out) + f + spatial)
+        # interleave: (N, C, d1, f1, d2, f2, ...)
+        perm = [0, 1]
+        for i in range(nd_):
+            perm += [2 + nd_ + i, 2 + i]
+        x = F.transpose(x, axes=tuple(perm))
+        out_spatial = tuple(d * fi for d, fi in zip(spatial, f))
+        return F.reshape(x, (n, c_out) + out_spatial)
+
+    def __repr__(self):
+        return "%s(factors=%s)" % (type(self).__name__, (self._factors,))
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) (reference contrib/nn/basic_layers.py:244)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2)
+    (reference contrib/nn/basic_layers.py:292)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)
+    (reference contrib/nn/basic_layers.py:354)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

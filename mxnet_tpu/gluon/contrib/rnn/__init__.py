@@ -1,0 +1,7 @@
+"""Contrib recurrent cells (reference
+``python/mxnet/gluon/contrib/rnn/``)."""
+from .rnn_cell import *  # noqa: F401,F403
+from .conv_rnn_cell import *  # noqa: F401,F403
+from . import rnn_cell, conv_rnn_cell
+
+__all__ = rnn_cell.__all__ + conv_rnn_cell.__all__

@@ -1,0 +1,248 @@
+"""Convolutional recurrent cells (reference
+``python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py``: _BaseConvRNNCell :37,
+Conv{1,2,3}DRNNCell :218-:397, Conv{1,2,3}DLSTMCell :473-:681,
+Conv{1,2,3}DGRUCell :762-:906).
+
+The recurrent step replaces the gated cells' dense i2h/h2h projections with
+convolutions over a spatial state.  Gate orders match the dense cells
+(LSTM [i, f, g, o], GRU [r, z, n]); each step is two XLA convs + fused
+elementwise gates.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....gluon.rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(x, n, name):
+    if isinstance(x, (int, onp.integer)):
+        return (int(x),) * n
+    t = tuple(int(v) for v in x)
+    assert len(t) == n, "%s must have %d elements" % (name, n)
+    return t
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared conv parameter plumbing (reference conv_rnn_cell.py:37).
+
+    ``input_shape`` is (C, d1..dk) and is required up front (like the
+    reference) so state/kernel shapes are static — jit-friendly.
+    """
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, dims, conv_layout, activation,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert conv_layout in ("NCW", "NCHW", "NCDHW"), \
+            "only channel-first layouts are supported"
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)
+        self._conv_layout = conv_layout
+        self._activation = activation
+        self._dims = dims
+        self._i2h_kernel = _tup(i2h_kernel, dims, "i2h_kernel")
+        self._h2h_kernel = _tup(h2h_kernel, dims, "h2h_kernel")
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, \
+                "h2h_kernel must be odd so the state keeps its shape"
+        self._i2h_pad = _tup(i2h_pad, dims, "i2h_pad")
+        self._i2h_dilate = _tup(i2h_dilate, dims, "i2h_dilate")
+        self._h2h_dilate = _tup(h2h_dilate, dims, "h2h_dilate")
+        # state spatial dims after the i2h conv (stride 1)
+        in_c = self._input_shape[0]
+        spatial = self._input_shape[1:]
+        self._state_shape = (hidden_channels,) + tuple(
+            (d + 2 * p - dil * (k - 1) - 1) + 1
+            for d, p, dil, k in zip(spatial, self._i2h_pad,
+                                    self._i2h_dilate, self._i2h_kernel))
+        # same-padding for h2h so the state shape is preserved
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+        ng = self._num_gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight",
+            shape=(ng * hidden_channels, in_c) + self._i2h_kernel,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(ng * hidden_channels, hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_channels,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_channels,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    @property
+    def _num_gates(self):
+        raise NotImplementedError
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}] * self._n_states
+
+    def infer_shape(self, x, *args):
+        pass  # shapes fixed at construction (input_shape is mandatory)
+
+    def _conv_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        ng = self._num_gates
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            dilate=self._i2h_dilate,
+                            num_filter=ng * self._hidden_channels)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            dilate=self._h2h_dilate,
+                            num_filter=ng * self._hidden_channels)
+        return i2h, h2h
+
+    def hybrid_forward(self, F, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return "%s(%s -> %s)" % (type(self).__name__,
+                                 (self._input_shape,),
+                                 self._hidden_channels)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _n_states = 1
+
+    @property
+    def _num_gates(self):
+        return 1
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _n_states = 2
+
+    @property
+    def _num_gates(self):
+        return 4
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.Activation(slices[2], act_type=self._activation)
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.Activation(next_c, act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _n_states = 1
+
+    @property
+    def _num_gates(self):
+        return 3
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        new = F.Activation(i2h_n + reset * h2h_n,
+                           act_type=self._activation)
+        next_h = (1 - update) * new + update * states[0]
+        return next_h, [next_h]
+
+
+def _make_cell(base, dims, layout, alias_doc):
+    class Cell(base):
+        __doc__ = alias_doc
+
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     i2h_weight_initializer=None,
+                     h2h_weight_initializer=None,
+                     i2h_bias_initializer="zeros",
+                     h2h_bias_initializer="zeros",
+                     conv_layout=layout, activation="tanh",
+                     prefix=None, params=None):
+            super().__init__(
+                input_shape=input_shape, hidden_channels=hidden_channels,
+                i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel,
+                i2h_pad=i2h_pad, i2h_dilate=i2h_dilate,
+                h2h_dilate=h2h_dilate,
+                i2h_weight_initializer=i2h_weight_initializer,
+                h2h_weight_initializer=h2h_weight_initializer,
+                i2h_bias_initializer=i2h_bias_initializer,
+                h2h_bias_initializer=h2h_bias_initializer,
+                dims=dims, conv_layout=conv_layout, activation=activation,
+                prefix=prefix, params=params)
+    return Cell
+
+
+Conv1DRNNCell = _make_cell(
+    _ConvRNNCell, 1, "NCW",
+    "1D convolutional RNN cell (reference conv_rnn_cell.py:218).")
+Conv2DRNNCell = _make_cell(
+    _ConvRNNCell, 2, "NCHW",
+    "2D convolutional RNN cell (reference conv_rnn_cell.py:285).")
+Conv3DRNNCell = _make_cell(
+    _ConvRNNCell, 3, "NCDHW",
+    "3D convolutional RNN cell (reference conv_rnn_cell.py:352).")
+Conv1DLSTMCell = _make_cell(
+    _ConvLSTMCell, 1, "NCW",
+    "1D ConvLSTM cell (Shi et al. 2015; reference conv_rnn_cell.py:473).")
+Conv2DLSTMCell = _make_cell(
+    _ConvLSTMCell, 2, "NCHW",
+    "2D ConvLSTM cell (Shi et al. 2015; reference conv_rnn_cell.py:550).")
+Conv3DLSTMCell = _make_cell(
+    _ConvLSTMCell, 3, "NCDHW",
+    "3D ConvLSTM cell (Shi et al. 2015; reference conv_rnn_cell.py:627).")
+Conv1DGRUCell = _make_cell(
+    _ConvGRUCell, 1, "NCW",
+    "1D convolutional GRU cell (reference conv_rnn_cell.py:762).")
+Conv2DGRUCell = _make_cell(
+    _ConvGRUCell, 2, "NCHW",
+    "2D convolutional GRU cell (reference conv_rnn_cell.py:834).")
+Conv3DGRUCell = _make_cell(
+    _ConvGRUCell, 3, "NCDHW",
+    "3D convolutional GRU cell (reference conv_rnn_cell.py:906).")
+
+for _c, _nm in [(Conv1DRNNCell, "Conv1DRNNCell"),
+                (Conv2DRNNCell, "Conv2DRNNCell"),
+                (Conv3DRNNCell, "Conv3DRNNCell"),
+                (Conv1DLSTMCell, "Conv1DLSTMCell"),
+                (Conv2DLSTMCell, "Conv2DLSTMCell"),
+                (Conv3DLSTMCell, "Conv3DLSTMCell"),
+                (Conv1DGRUCell, "Conv1DGRUCell"),
+                (Conv2DGRUCell, "Conv2DGRUCell"),
+                (Conv3DGRUCell, "Conv3DGRUCell")]:
+    _c.__name__ = _nm
+    _c.__qualname__ = _nm

@@ -184,6 +184,17 @@ def upsampling(*data, scale: int = 1, sample_type: str = "nearest",
 # normalization
 # ---------------------------------------------------------------------------
 
+def _bn_apply(data, mean, var, gamma, beta, eps, fix_gamma, axis):
+    """Normalize + affine, the part shared by BatchNorm / SyncBatchNorm."""
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    shape = [1] * data.ndim
+    shape[axis % data.ndim] = data.shape[axis % data.ndim]
+    shp = tuple(shape)
+    out = (data - mean.reshape(shp)) * lax.rsqrt(var.reshape(shp) + eps) \
+        * g.reshape(shp) + beta.reshape(shp)
+    return out, lax.stop_gradient(mean), lax.stop_gradient(var)
+
+
 @register("BatchNorm", num_outputs=3, needs_training=True,
           aliases=("batch_norm", "BatchNorm_v1"))
 def batch_norm(data, gamma, beta, moving_mean, moving_var,
@@ -198,18 +209,68 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var,
     eagerly and under jit (aux-state updates become extra jit outputs).
     """
     ax = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
     if use_global_stats or not training:
         mean, var = moving_mean, moving_var
     else:
         mean = jnp.mean(data, axis=ax)
         var = jnp.var(data, axis=ax)
-    shape = [1] * data.ndim
-    shape[axis % data.ndim] = data.shape[axis % data.ndim]
-    shp = tuple(shape)
-    out = (data - mean.reshape(shp)) * lax.rsqrt(var.reshape(shp) + eps) \
-        * g.reshape(shp) + beta.reshape(shp)
-    return out, lax.stop_gradient(mean), lax.stop_gradient(var)
+    return _bn_apply(data, mean, var, gamma, beta, eps, fix_gamma, axis)
+
+
+def _bound_axis_names():
+    """Mapped-context axis names currently in scope (None if the
+    introspection API is unavailable in this jax version)."""
+    try:
+        from jax._src.core import get_axis_env
+    except ImportError:
+        return None
+    try:
+        return tuple(get_axis_env().axis_sizes)
+    except Exception:
+        return None
+
+
+@register("_contrib_SyncBatchNorm", num_outputs=3, needs_training=True,
+          aliases=("SyncBatchNorm",))
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                    eps: float = 1e-3, momentum: float = 0.9,
+                    fix_gamma: bool = True, use_global_stats: bool = False,
+                    output_mean_var: bool = False, ndev: int = 1,
+                    key: str = "dp", training: bool = True):
+    """Cross-device BatchNorm (reference src/operator/contrib/sync_batch_norm).
+
+    The reference's only cross-device op: workers exchange batch statistics
+    before normalizing.  TPU-native this is a ``lax.pmean`` of (mean, E[x²])
+    over the data-parallel mesh axis named ``key`` — when called inside a
+    mapped context (shard_map/pjit step); standalone (no mapped axes bound)
+    it degrades to local BatchNorm, matching ndev=1 semantics.  Calling it
+    inside a mapped context whose axes do NOT include ``key`` is an error —
+    silently falling back to per-device stats is the one failure this op
+    exists to prevent.
+    """
+    ax = tuple(i for i in range(data.ndim) if i != 1)
+    if use_global_stats or not training:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=ax)
+        sq = jnp.mean(jnp.square(data), axis=ax)
+        bound = _bound_axis_names()
+        if bound is None:
+            # no introspection: best effort — sync when the axis resolves
+            try:
+                mean = lax.pmean(mean, key)
+                sq = lax.pmean(sq, key)
+            except NameError:
+                pass
+        elif key in bound:
+            mean = lax.pmean(mean, key)
+            sq = lax.pmean(sq, key)
+        elif bound:
+            raise ValueError(
+                "SyncBatchNorm key=%r is not a bound mesh axis (bound: %r);"
+                " pass key=<your data-parallel axis name>" % (key, bound))
+        var = sq - jnp.square(mean)
+    return _bn_apply(data, mean, var, gamma, beta, eps, fix_gamma, axis=1)
 
 
 @register("LayerNorm", aliases=("layer_norm",))

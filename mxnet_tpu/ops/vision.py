@@ -1,0 +1,103 @@
+"""Vision operators (reference ``src/operator/{roi_pooling,bilinear_sampler,
+spatial_transformer,...}`` and ``src/operator/contrib/``).
+
+All kernels are static-shape jnp/lax compositions: sampling grids become
+XLA gathers, pooling becomes windowed reductions, and the per-ROI loops of
+the CUDA kernels become vmaps — no dynamic shapes, so everything jits.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _bilinear_gather(data, py, px, pad_mode_zero=True):
+    """Sample ``data`` (C, H, W) at fractional positions (py, px) — any
+    matching shapes — with bilinear interpolation and zero padding outside.
+
+    The workhorse shared by BilinearSampler / SpatialTransformer /
+    deformable convolution / ROIAlign (reference implements each as its own
+    CUDA kernel; here one gather composition serves all).
+    """
+    C, H, W = data.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+
+    def tap(yi, xi):
+        inside = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1))
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = data[:, yc, xc]  # (C, *pos_shape)
+        if pad_mode_zero:
+            v = v * inside.astype(data.dtype)
+        return v
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wy = wy.astype(data.dtype)
+    wx = wx.astype(data.dtype)
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter: int = 0, num_group: int = 1,
+                           num_deformable_group: int = 1,
+                           no_bias: bool = False, workspace: int = 1024,
+                           layout=None):
+    """Deformable convolution v1 (reference
+    src/operator/contrib/deformable_convolution-inl.h).
+
+    Sampling positions are the regular conv grid plus learned per-position
+    offsets; the bilinear im2col becomes a batched XLA gather and the
+    contraction one MXU matmul.
+    offset: (N, 2*KH*KW*num_deformable_group, OH, OW), pairs ordered (y, x).
+    """
+    N, C, H, W = data.shape
+    KH, KW = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    OH = (H + 2 * ph - dh * (KH - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (KW - 1) - 1) // sw + 1
+    dg = num_deformable_group
+    cg = C // dg  # channels per deformable group
+
+    oy, ox = jnp.meshgrid(jnp.arange(OH), jnp.arange(OW), indexing="ij")
+    ky, kx = jnp.meshgrid(jnp.arange(KH), jnp.arange(KW), indexing="ij")
+    # base grid: (KH*KW, OH, OW)
+    base_y = (oy[None] * sh - ph) + (ky.reshape(-1, 1, 1) * dh)
+    base_x = (ox[None] * sw - pw) + (kx.reshape(-1, 1, 1) * dw)
+
+    off = offset.reshape(N, dg, KH * KW, 2, OH, OW)
+
+    def one_image(img, off_i):
+        # img (C,H,W) -> cols (C, KH*KW, OH, OW)
+        def one_dgroup(chans, o):
+            py = base_y + o[:, 0]
+            px = base_x + o[:, 1]
+            return _bilinear_gather(chans, py, px)  # (cg, KH*KW, OH, OW)
+        cols = jax.vmap(one_dgroup)(img.reshape(dg, cg, H, W), off_i)
+        return cols.reshape(C, KH * KW, OH, OW)
+
+    cols = jax.vmap(one_image)(data, off)  # (N, C, KH*KW, OH, OW)
+    # grouped contraction: (N, G, cg_w*KH*KW, OH*OW) x (G, F/G, cg_w*KH*KW)
+    G = num_group
+    cw = C // G
+    cols = cols.reshape(N, G, cw * KH * KW, OH * OW)
+    w = weight.reshape(G, num_filter // G, cw * KH * KW)
+    out = jnp.einsum("ngkp,gfk->ngfp", cols, w)
+    out = out.reshape(N, num_filter, OH, OW)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

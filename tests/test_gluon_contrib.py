@@ -1,0 +1,222 @@
+"""Gluon contrib tests (reference
+``tests/python/unittest/test_gluon_contrib.py``)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import contrib
+
+
+def test_concurrent():
+    net = contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(4), contrib.nn.Identity())
+    net.initialize()
+    x = mx.nd.array(onp.random.rand(2, 4).astype("float32"))
+    out = net(x)
+    assert out.shape == (2, 8)
+    # identity branch passes input through unchanged
+    assert onp.allclose(out.asnumpy()[:, 4:], x.asnumpy())
+
+
+def test_identity():
+    ident = contrib.nn.Identity()
+    x = mx.nd.array(onp.random.rand(3, 5).astype("float32"))
+    assert onp.allclose(ident(x).asnumpy(), x.asnumpy())
+
+
+@pytest.mark.parametrize("factor,shape,expect", [
+    (3, (2, 6, 5), (2, 2, 15)),
+    (2, (2, 8, 3, 3), (2, 2, 6, 6)),
+    ((1, 2, 2), (1, 8, 2, 3, 3), (1, 2, 2, 6, 6)),
+])
+def test_pixelshuffle_shapes(factor, shape, expect):
+    ndim = len(shape) - 2
+    cls = {1: contrib.nn.PixelShuffle1D, 2: contrib.nn.PixelShuffle2D,
+           3: contrib.nn.PixelShuffle3D}[ndim]
+    layer = cls(factor)
+    x = mx.nd.array(onp.random.rand(*shape).astype("float32"))
+    assert layer(x).shape == expect
+
+
+def test_pixelshuffle2d_values():
+    f = 2
+    a = onp.random.rand(2, 8, 3, 3).astype("float32")
+    got = contrib.nn.PixelShuffle2D(f)(mx.nd.array(a)).asnumpy()
+    n, c, h, w = a.shape
+    co = c // (f * f)
+    want = a.reshape(n, co, f, f, h, w).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(n, co, h * f, w * f)
+    assert onp.allclose(got, want)
+
+
+def test_sync_batchnorm_standalone_matches_bn():
+    sbn = contrib.nn.SyncBatchNorm(in_channels=3)
+    bn = gluon.nn.BatchNorm(in_channels=3)
+    sbn.initialize()
+    bn.initialize()
+    x = mx.nd.array(onp.random.rand(4, 3, 5, 5).astype("float32"))
+    with mx.autograd.record():
+        o1 = sbn(x)
+    with mx.autograd.record():
+        o2 = bn(x)
+    assert onp.allclose(o1.asnumpy(), o2.asnumpy(), atol=1e-5)
+
+
+def test_sync_batchnorm_cross_device():
+    """Stats must be the GLOBAL batch stats when run inside shard_map."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from mxnet_tpu.ops.nn import sync_batch_norm
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("dp",))
+    x = onp.random.RandomState(0).rand(16, 3, 4, 4).astype("float32") * 5
+    gamma = onp.ones(3, "float32")
+    beta = onp.zeros(3, "float32")
+    mm = onp.zeros(3, "float32")
+    mv = onp.ones(3, "float32")
+
+    def local(xs):
+        return sync_batch_norm(xs, gamma, beta, mm, mv, fix_gamma=False,
+                               key="dp", training=True)
+
+    out, mean, var = shard_map(local, mesh=mesh, in_specs=(P("dp"),),
+                               out_specs=(P("dp"), P(), P()))(x)
+    gmean = x.mean(axis=(0, 2, 3))
+    gvar = x.var(axis=(0, 2, 3))
+    ref = (x - gmean.reshape(1, -1, 1, 1)) \
+        / onp.sqrt(gvar.reshape(1, -1, 1, 1) + 1e-3)
+    assert onp.allclose(onp.asarray(mean), gmean, atol=1e-5)
+    assert onp.allclose(onp.asarray(out), ref, atol=1e-4)
+
+
+def test_lstmp_cell():
+    cell = contrib.rnn.LSTMPCell(8, 4)
+    cell.initialize()
+    xs = mx.nd.array(onp.random.rand(2, 5, 6).astype("float32"))
+    out, states = cell.unroll(5, xs, merge_outputs=True)
+    assert out.shape == (2, 5, 4)           # projected size
+    assert states[0].shape == (2, 4)        # h: projection
+    assert states[1].shape == (2, 8)        # c: hidden
+
+
+def test_variational_dropout_cell():
+    base = gluon.rnn.GRUCell(7)
+    vd = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                            drop_outputs=0.5)
+    vd.initialize()
+    x = mx.nd.array(onp.random.rand(2, 4, 5).astype("float32"))
+    with mx.autograd.record():
+        out, _ = vd.unroll(4, x, merge_outputs=True)
+    assert out.shape == (2, 4, 7)
+    # same mask every step: zeroed output channels are zero at EVERY step
+    o = out.asnumpy()
+    zero_cols = (o == 0).all(axis=1)
+    assert zero_cols.any(), "expected some dropped output channels"
+
+
+def test_conv_rnn_cells():
+    c2 = contrib.rnn.Conv2DLSTMCell((3, 8, 8), 6, (3, 3), (3, 3),
+                                    i2h_pad=(1, 1))
+    c2.initialize()
+    seq = mx.nd.array(onp.random.rand(2, 4, 3, 8, 8).astype("float32"))
+    out, states = c2.unroll(4, seq, merge_outputs=True)
+    assert out.shape == (2, 4, 6, 8, 8)
+    assert states[0].shape == (2, 6, 8, 8)
+    assert states[1].shape == (2, 6, 8, 8)
+
+    cg = contrib.rnn.Conv1DGRUCell((2, 10), 4, 3, 3, i2h_pad=1)
+    cg.initialize()
+    out, _ = cg.unroll(3, mx.nd.array(
+        onp.random.rand(2, 3, 2, 10).astype("float32")), merge_outputs=True)
+    assert out.shape == (2, 3, 4, 10)
+
+    cr = contrib.rnn.Conv3DRNNCell((2, 4, 4, 4), 3, 3, 3, i2h_pad=1)
+    cr.initialize()
+    out, _ = cr.unroll(2, mx.nd.array(
+        onp.random.rand(1, 2, 2, 4, 4, 4).astype("float32")),
+        merge_outputs=True)
+    assert out.shape == (1, 2, 3, 4, 4, 4)
+
+
+def test_deformable_convolution_zero_offset():
+    """With zero offsets a deformable conv IS a regular conv."""
+    dc = contrib.cnn.DeformableConvolution(5, kernel_size=(3, 3),
+                                           padding=(1, 1), in_channels=4)
+    dc.initialize()
+    x = mx.nd.array(onp.random.rand(2, 4, 7, 7).astype("float32"))
+    out = dc(x)
+    ref = mx.nd.Convolution(x, dc.weight.data(), dc.bias.data(),
+                            kernel=(3, 3), pad=(1, 1), num_filter=5)
+    assert onp.allclose(out.asnumpy(), ref.asnumpy(), atol=1e-4)
+
+
+def test_deformable_convolution_grad():
+    dc = contrib.cnn.DeformableConvolution(
+        2, kernel_size=(3, 3), padding=(1, 1), in_channels=3,
+        offset_weight_initializer="normal")
+    dc.initialize()
+    x = mx.nd.array(onp.random.rand(1, 3, 5, 5).astype("float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = dc(x)
+        loss = out.sum()
+    loss.backward()
+    assert x.grad is not None
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_interval_sampler():
+    s = list(contrib.data.IntervalSampler(10, 3))
+    assert s == [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    s = list(contrib.data.IntervalSampler(10, 3, rollover=False))
+    assert s == [0, 3, 6, 9]
+
+
+def test_estimator_fit():
+    import warnings
+    rs = onp.random.RandomState(0)
+    X = rs.rand(256, 10).astype("float32")
+    W = rs.normal(size=(10, 3)).astype("float32")
+    Y = (X @ W).argmax(1).astype("float32")
+    ds = gluon.data.ArrayDataset(mx.nd.array(X), mx.nd.array(Y))
+    dl = gluon.data.DataLoader(ds, batch_size=32)
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    est = contrib.estimator.Estimator(
+        net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+        metrics=mx.metric.Accuracy(),
+        trainer=gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.5}))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        est.fit(dl, val_data=dl, epochs=8)
+    _, acc = est.train_metrics[0].get()
+    assert acc > 0.8, acc
+
+
+def test_estimator_early_stopping():
+    import warnings
+    rs = onp.random.RandomState(0)
+    X = rs.rand(64, 5).astype("float32")
+    Y = (X.sum(1) > 2.5).astype("float32")
+    ds = gluon.data.ArrayDataset(mx.nd.array(X), mx.nd.array(Y))
+    dl = gluon.data.DataLoader(ds, batch_size=16)
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    acc = mx.metric.Accuracy()
+    handler = contrib.estimator.EarlyStoppingHandler(
+        monitor=acc, patience=1, mode="max")
+    est = contrib.estimator.Estimator(
+        net, metrics=acc,
+        trainer=gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.0}))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        est.fit(dl, epochs=50, event_handlers=[handler])
+    # zero lr => no improvement => stops long before 50 epochs
+    assert handler.stop_training
+    assert handler.current_epoch < 10

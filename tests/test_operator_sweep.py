@@ -623,6 +623,9 @@ COVERED_ELSEWHERE = {
     "_random_generalized_negative_binomial",
     "random_negative_binomial", "random_generalized_negative_binomial",
     "multinomial", "shuffle",
+    # tested in tests/test_gluon_contrib.py (layer-level value checks)
+    "_contrib_SyncBatchNorm", "SyncBatchNorm",
+    "_contrib_DeformableConvolution", "DeformableConvolution",
     # aliases of tested canonical ops
     "activation", "batch_norm", "convolution", "deconvolution", "dropout",
     "fully_connected", "layer_norm", "linear_regression_output",
