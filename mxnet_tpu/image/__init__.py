@@ -6,7 +6,14 @@ from .image import (  # noqa: F401
     CastAug, RandomCropAug, RandomSizedCropAug, CenterCropAug,
     HorizontalFlipAug, BrightnessJitterAug, ContrastJitterAug,
     SaturationJitterAug, ColorJitterAug, LightingAug, ColorNormalizeAug,
+    HueJitterAug, RandomGrayAug, copyMakeBorder,
     CreateAugmenter, ImageIter)
+from .detection import (  # noqa: F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateMultiRandCropAugmenter,
+    CreateDetAugmenter, ImageDetIter)
+from . import detection  # noqa: F401
+from . import detection as det  # noqa: F401
 
 __all__ = [
     "imdecode", "imread", "imresize", "imrotate", "resize_short",
@@ -15,5 +22,8 @@ __all__ = [
     "ResizeAug", "ForceResizeAug", "CastAug", "RandomCropAug",
     "RandomSizedCropAug", "CenterCropAug", "HorizontalFlipAug",
     "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
-    "ColorJitterAug", "LightingAug", "ColorNormalizeAug", "CreateAugmenter",
-    "ImageIter"]
+    "ColorJitterAug", "LightingAug", "ColorNormalizeAug", "HueJitterAug",
+    "RandomGrayAug", "copyMakeBorder", "CreateAugmenter", "ImageIter",
+    "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+    "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+    "CreateMultiRandCropAugmenter", "CreateDetAugmenter", "ImageDetIter"]

@@ -1,0 +1,478 @@
+"""Detection-specific augmentation + iterator (reference
+``python/mxnet/image/detection.py``: DetAugmenter :39, DetBorrowAug :65,
+DetRandomSelectAug :90, DetHorizontalFlipAug :126, DetRandomCropAug :152,
+DetRandomPadAug :323, CreateDetAugmenter :482, ImageDetIter :624).
+
+Detection augmenters transform (image, label) pairs, where label rows are
+``[cls_id, xmin, ymin, xmax, ymax, ...]`` with coordinates normalized to
+[0, 1].  All label math is host-side numpy (it is control flow, not tensor
+compute); the TPU sees only the final batched arrays.
+"""
+from __future__ import annotations
+
+import json
+import random
+
+import numpy as onp
+
+from .image import (Augmenter, ResizeAug, ForceResizeAug, CastAug,
+                    ColorJitterAug, HueJitterAug, LightingAug,
+                    RandomGrayAug, ColorNormalizeAug, copyMakeBorder,
+                    fixed_crop, ImageIter, _np)
+from .. import ndarray as nd
+from ..io.io import DataBatch, DataDesc
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+class DetAugmenter(object):
+    """Base detection augmenter: ``__call__(src, label)`` (reference
+    detection.py:39)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift a label-invariant classification augmenter into the detection
+    pipeline (reference detection.py:65)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise TypeError("Borrowing from invalid Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Pick one augmenter at random, or skip all with ``skip_prob``
+    (reference detection.py:90)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        super().__init__(skip_prob=skip_prob)
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise ValueError("Allow DetAugmenter in list only")
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob if aug_list else 1
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [x.dumps() for x in self.aug_list]]
+
+    def __call__(self, src, label):
+        if random.random() < self.skip_prob:
+            return src, label
+        return random.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and x-coordinates with probability p (reference
+    detection.py:126)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if random.random() < self.p:
+            img = _np(src)
+            src = nd.array(img[:, ::-1].copy())
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+def _box_areas(boxes):
+    """Areas of [x1, y1, x2, y2] rows (normalized coords)."""
+    return (onp.maximum(0, boxes[:, 2] - boxes[:, 0])
+            * onp.maximum(0, boxes[:, 3] - boxes[:, 1]))
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop (reference detection.py:152): the crop must
+    cover at least ``min_object_covered`` of some object, lie within the
+    area/aspect-ratio ranges, and objects whose post-crop remainder falls
+    below ``min_eject_coverage`` are dropped from the label."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.enabled = (0 < area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0]
+                        <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        img = _np(src)
+        height, width = img.shape[:2]
+        crop = self._propose(label, height, width)
+        if crop:
+            x, y, w, h, label = crop
+            src = fixed_crop(src, x, y, w, h, None)
+        return src, label
+
+    def _covered_enough(self, label, box, width, height):
+        """At least one real object has >= min_object_covered of its area
+        inside the candidate crop box (normalized coords)."""
+        x1, y1, x2, y2 = box
+        objs = label[:, 1:5]
+        areas = _box_areas(objs)
+        real = areas * width * height > 2
+        if not real.any():
+            return False
+        objs = objs[real]
+        inter = onp.stack([
+            onp.maximum(objs[:, 0], x1), onp.maximum(objs[:, 1], y1),
+            onp.minimum(objs[:, 2], x2), onp.minimum(objs[:, 3], y2)],
+            axis=1)
+        cov = _box_areas(inter) / areas[real]
+        cov = cov[cov > 0]
+        return cov.size > 0 and cov.min() > self.min_object_covered
+
+    def _crop_labels(self, label, crop_px, height, width):
+        """Re-express labels in the crop frame; eject tiny remainders."""
+        cx, cy, cw, ch = crop_px
+        x0, y0 = cx / width, cy / height
+        sw, sh = cw / width, ch / height
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - x0) / sw
+        out[:, (2, 4)] = (out[:, (2, 4)] - y0) / sh
+        out[:, 1:5] = onp.clip(out[:, 1:5], 0, 1)
+        coverage = _box_areas(out[:, 1:5]) * sw * sh \
+            / onp.maximum(_box_areas(label[:, 1:5]), 1e-12)
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2]) \
+            & (coverage > self.min_eject_coverage)
+        if not valid.any():
+            return None
+        return out[valid]
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = random.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h_lo = int(round((min_area / ratio) ** 0.5))
+            h_hi = int(round((max_area / ratio) ** 0.5))
+            h_hi = min(h_hi, height, int((width + 0.4999999) / ratio))
+            h = min(h_lo, h_hi)
+            if h < h_hi:
+                h = random.randint(h, h_hi)
+            w = int(round(h * ratio))
+            if not (min_area <= w * h <= max_area
+                    and 0 < w <= width and 0 < h <= height):
+                continue
+            y = random.randint(0, max(0, height - h))
+            x = random.randint(0, max(0, width - w))
+            box = (x / width, y / height, (x + w) / width, (y + h) / height)
+            if (w * h >= 2
+                    and self._covered_enough(label, box, width, height)):
+                new_label = self._crop_labels(label, (x, y, w, h),
+                                              height, width)
+                if new_label is not None:
+                    return (x, y, w, h, new_label)
+        return ()
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-and-pad (reference detection.py:323): place the image
+    inside a larger canvas filled with ``pad_val``; labels shrink
+    accordingly."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (list, tuple)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0
+                        and area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0]
+                        <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        img = _np(src)
+        height, width = img.shape[:2]
+        pad = self._propose(label, height, width)
+        if pad:
+            x, y, w, h, label = pad
+            src = copyMakeBorder(src, y, h - y - height, x, w - x - width,
+                                 16, values=self.pad_val)
+        return src, label
+
+    def _pad_labels(self, label, pad_px, height, width):
+        x, y, w, h = pad_px
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] * width + x) / w
+        out[:, (2, 4)] = (out[:, (2, 4)] * height + y) / h
+        return out
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = random.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h_hi = int(round((max_area / ratio) ** 0.5))
+            # lower bound from the min-area constraint AND from having to
+            # contain the original image in both dimensions
+            h_lo = int(round((min_area / ratio) ** 0.5))
+            if round(h_lo * ratio) < width:
+                h_lo = int((width + 0.499999) / ratio)
+            h_lo = max(h_lo, height)
+            h = min(h_lo, h_hi)
+            if h < h_hi:
+                h = random.randint(h, h_hi)
+            w = int(round(h * ratio))
+            if (h - height) < 2 or (w - width) < 2:
+                continue
+            y = random.randint(0, max(0, h - height))
+            x = random.randint(0, max(0, w - width))
+            return (x, y, w, h, self._pad_labels(label, (x, y, w, h),
+                                                 height, width))
+        return ()
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """One DetRandomCropAug per parameter combination, randomly selected
+    per sample (reference detection.py:417)."""
+    params = [min_object_covered, aspect_ratio_range, area_range,
+              min_eject_coverage, max_attempts]
+    lists = [p if isinstance(p, list) else [p] for p in params]
+    n = max(len(p) for p in lists)
+    for i, p in enumerate(lists):
+        if len(p) != n:
+            assert len(p) == 1, "cannot align parameter list lengths"
+            lists[i] = p * n
+    augs = [DetRandomCropAug(min_object_covered=moc,
+                             aspect_ratio_range=arr, area_range=ar,
+                             min_eject_coverage=mec, max_attempts=ma)
+            for moc, arr, ar, mec, ma in zip(*lists)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection training pipeline (reference detection.py:482)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(area_range[1], 1.0)), min_eject_coverage,
+            max_attempts, skip_prob=(1 - rand_crop)))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad_aug = DetRandomPadAug(aspect_ratio_range,
+                                  (1.0, area_range[1]), max_attempts,
+                                  pad_val)
+        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection data iterator (reference detection.py:624).
+
+    Labels are variable-length object lists; batches pad to
+    ``label_shape = (max_objects, object_width)`` with -1 rows, the
+    reference's convention.  Raw list labels use the header encoding
+    ``[header_width, object_width, extra..., obj0..., obj1...]``.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="label", last_batch_handle="pad", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                         "rand_mirror", "mean", "std", "brightness",
+                         "contrast", "saturation", "pca_noise", "hue",
+                         "inter_method", "min_object_covered",
+                         "aspect_ratio_range", "area_range",
+                         "min_eject_coverage", "max_attempts", "pad_val")})
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle, aug_list=aug_list,
+                         imglist=imglist, data_name=data_name,
+                         label_name=label_name,
+                         last_batch_handle=last_batch_handle)
+        self.label_shape = self._estimate_label_shape()
+
+    def _parse_label(self, label):
+        """Decode the flat label record into (num_obj, obj_width) rows
+        (reference detection.py:93)."""
+        raw = label.asnumpy() if hasattr(label, "asnumpy") \
+            else onp.asarray(label)
+        raw = raw.ravel()
+        if raw.size < 2:
+            raise RuntimeError("label not recognized as detection format")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if (raw.size - header_width) % obj_width != 0:
+            raise RuntimeError("invalid label length %d" % raw.size)
+        out = onp.reshape(raw[header_width:], (-1, obj_width))
+        if (out[:, 1:5] > 1.0).any() or (out[:, 1:5] < 0.0).any():
+            raise RuntimeError("label coordinates must be normalized")
+        return out.astype("float32")
+
+    def _estimate_label_shape(self):
+        """Max object count across the dataset (reference
+        detection.py:79)."""
+        max_count = 0
+        obj_width = 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                label = self._parse_label(label)
+                max_count = max(max_count, label.shape[0])
+                obj_width = label.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return (max_count, obj_width)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + tuple(self.label_shape))]
+
+    def reshape(self, data_shape=None, label_shape=None):
+        """Adjust data/label shapes between epochs (reference
+        detection.py:119)."""
+        if data_shape is not None:
+            self.data_shape = data_shape
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.label_shape = label_shape
+
+    def check_label_shape(self, label_shape):
+        if len(label_shape) != 2:
+            raise ValueError("label_shape should have length 2")
+        if label_shape[0] < self.label_shape[0]:
+            raise ValueError(
+                "expected at least %d padding rows, got %d"
+                % (self.label_shape[0], label_shape[0]))
+        if label_shape[1] != self.label_shape[1]:
+            raise ValueError("object width mismatch: %d vs %d"
+                             % (self.label_shape[1], label_shape[1]))
+
+    def augmentation_transform(self, data, label):
+        for aug in self.auglist:
+            data, label = aug(data, label)
+        return data, label
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = onp.zeros((self.batch_size, h, w, c), "float32")
+        batch_label = onp.full((self.batch_size,) + self.label_shape, -1.0,
+                               "float32")
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                raw_label, img = self.next_sample()
+                label = self._parse_label(raw_label)
+                img, label = self.augmentation_transform(img, label)
+                data = _np(img)
+                assert data.shape[:2] == (h, w), \
+                    "augmented image shape %s != data_shape %s" % (
+                        data.shape, (h, w))
+                n = min(label.shape[0], self.label_shape[0])
+                batch_label[i, :n] = label[:n]
+                batch_data[i] = data
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        data = nd.array(batch_data.transpose(0, 3, 1, 2))
+        label = nd.array(batch_label)
+        return DataBatch(data=[data], label=[label], pad=pad, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -296,6 +296,63 @@ class SaturationJitterAug(Augmenter):
         return array(img * alpha + gray * (1 - alpha), dtype="float32")
 
 
+class HueJitterAug(Augmenter):
+    """Random hue rotation in YIQ space (reference image.py HueJitterAug)."""
+
+    _tyiq = onp.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], "float32")
+    _ityiq = onp.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], "float32")
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = random.uniform(-self.hue, self.hue)
+        u = onp.cos(alpha * onp.pi)
+        w = onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], "float32")
+        t = self._ityiq @ bt @ self._tyiq
+        img = _np(src).astype("float32")
+        return array(img @ t.T, dtype="float32")
+
+
+class RandomGrayAug(Augmenter):
+    """With probability p collapse to grayscale in all channels
+    (reference image.py RandomGrayAug)."""
+
+    coef = onp.array([0.299, 0.587, 0.114], "float32")
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            img = _np(src).astype("float32")
+            gray = (img * self.coef).sum(axis=-1, keepdims=True)
+            return array(onp.broadcast_to(gray, img.shape).copy(),
+                         dtype="float32")
+        return src
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=16, values=0):
+    """Constant-border padding (reference: cv2.copyMakeBorder via
+    mx.image; border_type 16 = BORDER_CONSTANT is the only mode here)."""
+    img = _np(src)
+    out = onp.empty((img.shape[0] + top + bot, img.shape[1] + left + right)
+                    + img.shape[2:], img.dtype)
+    vals = onp.asarray(values, img.dtype)
+    out[...] = vals.reshape((1, 1, -1)) if vals.ndim else vals
+    out[top:top + img.shape[0], left:left + img.shape[1]] = img
+    return array(out, dtype=str(img.dtype))
+
+
 class ColorJitterAug(RandomOrderAug):
     def __init__(self, brightness, contrast, saturation):
         ts = []
@@ -355,12 +412,16 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
     if pca_noise > 0:
         eigval = onp.array([55.46, 4.794, 1.148])
         eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
                             [-0.5808, -0.0045, -0.8140],
                             [-0.5836, -0.6948, 0.4203]])
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:
@@ -418,7 +479,7 @@ class ImageIter(DataIter):
                 k: v for k, v in kwargs.items()
                 if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
                          "mean", "std", "brightness", "contrast", "saturation",
-                         "pca_noise", "inter_method")})
+                         "hue", "rand_gray", "pca_noise", "inter_method")})
         else:
             self.auglist = aug_list
         self.cur = 0

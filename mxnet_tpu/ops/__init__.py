@@ -13,5 +13,6 @@ from . import random_ops    # noqa: F401
 from . import rnn           # noqa: F401
 from . import control_flow  # noqa: F401
 from . import vision        # noqa: F401
+from . import contrib_ops   # noqa: F401
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]

@@ -1,0 +1,121 @@
+"""Misc contrib operators (reference ``src/operator/contrib/``:
+transformer.cc, quadratic_op.cc, index_array.cc, index_copy.cc, fft.cc,
+ifft.cc, count_sketch.cc, all_finite.cc, gradient_multiplier_op.cc,
+boolean_mask.cc).
+
+Each collapses to a few lines of jnp/lax; the CUDA kernels' job (tiling,
+layout) is XLA's here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    """out = data / sqrt(data.shape[-1]) (reference
+    src/operator/contrib/transformer.cc:34 — attention-score rescale)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a: float = 0.0, b: float = 0.0, c: float = 0.0):
+    """out = a*x^2 + b*x + c (reference
+    src/operator/contrib/quadratic_op-inl.h:43-51 — the tutorial op)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_index_array", differentiable=False,
+          aliases=("index_array",))
+def index_array(data, axes=None):
+    """Map each element position to its N-d index (reference
+    src/operator/contrib/index_array.cc): output (..., len(axes)) int64."""
+    shape = data.shape
+    sel = tuple(range(len(shape))) if axes is None else tuple(axes)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    return jnp.stack([grids[a] for a in sel], axis=-1).astype(jnp.int64)
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old, index, new):
+    """Copy rows of ``new`` into ``old`` at ``index`` (out-of-place, like
+    the reference src/operator/contrib/index_copy.cc under kWriteTo)."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_fft", aliases=("fft",))
+def fft(data, compute_size: int = 128):
+    """FFT of the last axis, complex output interleaved [re, im] so the
+    output is a real tensor of shape (..., 2*d) (reference
+    src/operator/contrib/fft-inl.h; cuFFT there, XLA FFT here)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(data.dtype)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def ifft(data, compute_size: int = 128):
+    """Inverse of _contrib_fft: input (..., 2*d) interleaved [re, im] ->
+    real (..., d) (reference src/operator/contrib/ifft-inl.h).  Like cuFFT,
+    the reference does NOT normalize by d — neither do we."""
+    d = data.shape[-1] // 2
+    x = data.reshape(data.shape[:-1] + (d, 2))
+    comp = lax.complex(x[..., 0].astype(jnp.float32),
+                       x[..., 1].astype(jnp.float32))
+    return (jnp.fft.ifft(comp, axis=-1).real * d).astype(data.dtype)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim: int = 0,
+                 processing_batch_size: int = 32):
+    """Count-sketch projection (reference
+    src/operator/contrib/count_sketch-inl.h): out[:, h[i]] += s[i]*in[:, i].
+    The scatter-add is one jnp segment-sum."""
+    sgn = s.reshape(-1).astype(data.dtype)
+    idx = h.reshape(-1).astype(jnp.int32)
+    contrib = data * sgn[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, idx].add(contrib)
+
+
+@register("_contrib_gradient_multiplier", aliases=("gradient_multiplier",))
+def gradient_multiplier(data, scalar: float = 1.0):
+    """Identity forward, gradient scaled by ``scalar`` backward (reference
+    src/operator/contrib/gradient_multiplier_op.cc — gradient-reversal
+    layers use scalar=-lambda)."""
+
+    @jax.custom_vjp
+    def _gm(x):
+        return x
+
+    def _fwd(x):
+        return x, None
+
+    def _bwd(_, g):
+        return (g * scalar,)
+
+    _gm.defvjp(_fwd, _bwd)
+    return _gm(data)
+
+
+@register("all_finite", differentiable=False)
+def all_finite(data, init_output: bool = True):
+    """1.0 iff every element is finite (reference
+    src/operator/contrib/all_finite.cc — the AMP gradient-overflow probe).
+    Output shape (1,)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays: int = 1, init_output: bool = True):
+    """all_finite over a list of tensors in one fused reduction (reference
+    src/operator/contrib/all_finite.cc multi_all_finite)."""
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = ok & jnp.isfinite(a).all()
+    return ok.astype(jnp.float32).reshape(1)

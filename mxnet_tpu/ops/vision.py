@@ -101,3 +101,434 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# sampling-grid ops (BilinearSampler / SpatialTransformer / GridGenerator)
+# ---------------------------------------------------------------------------
+
+def _grid_dst(H, W, dtype=jnp.float32):
+    """Normalized target grid in [-1, 1]: rows (x, y) (reference
+    grid_generator-inl.h:97-105)."""
+    xs = -1.0 + jnp.arange(W, dtype=dtype) * (2.0 / (W - 1))
+    ys = -1.0 + jnp.arange(H, dtype=dtype) * (2.0 / (H - 1))
+    gx, gy = jnp.meshgrid(xs, ys)  # (H, W)
+    return gx, gy
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off: bool = False):
+    """Reference src/operator/bilinear_sampler.cc:49-54: sample data
+    (N,C,H,W) at grid (N,2,H',W') of normalized coords, channel 0 = x,
+    channel 1 = y; real = (norm + 1) * (size - 1) / 2, zero outside."""
+    def one(img, g):
+        H, W = img.shape[1:]
+        px = (g[0] + 1.0) * (W - 1) / 2.0
+        py = (g[1] + 1.0) * (H - 1) / 2.0
+        return _bilinear_gather(img, py, px)
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator", num_outputs=1, aliases=("grid_generator",))
+def grid_generator(data, transform_type: str = "affine",
+                   target_shape=(0, 0)):
+    """Reference src/operator/grid_generator-inl.h:85-131.
+
+    affine: data (N, 6) affine matrices -> sampling grid (N, 2, H, W) =
+    theta @ [x; y; 1] over the normalized target grid.
+    warp: data (N, 2, H, W) optical flow -> normalized (flow + pix grid).
+    """
+    if transform_type == "affine":
+        H, W = target_shape
+        gx, gy = _grid_dst(H, W, data.dtype)
+        dst = jnp.stack([gx.ravel(), gy.ravel(),
+                         jnp.ones(H * W, data.dtype)])  # (3, H*W)
+        # sampling COORDINATES: the TPU's default bf16 matmul precision
+        # (~3 decimal digits) visibly shifts sample positions — force full
+        # fp32 for this tiny (2x3)x(3xHW) product
+        out = jnp.matmul(data.reshape(-1, 2, 3), dst,
+                         precision=lax.Precision.HIGHEST)  # (N, 2, H*W)
+        return out.reshape(data.shape[0], 2, H, W)
+    if transform_type == "warp":
+        N, _, H, W = data.shape
+        px = jnp.arange(W, dtype=data.dtype)[None, :].repeat(H, 0)
+        py = jnp.arange(H, dtype=data.dtype)[:, None].repeat(W, 1)
+        pix = jnp.stack([px, py])  # (2, H, W)
+        denom = jnp.array([(W - 1) / 2.0, (H - 1) / 2.0],
+                          data.dtype).reshape(1, 2, 1, 1)
+        return (data + pix[None]) / denom - 1.0
+    raise ValueError("unknown transform_type %r" % transform_type)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type: str = "affine",
+                        sampler_type: str = "bilinear",
+                        cudnn_off: bool = False):
+    """Affine spatial transformer network op (reference
+    src/operator/spatial_transformer.cc:52-57): grid-generate from the
+    6-param loc net output, then bilinear-sample."""
+    assert transform_type == "affine" and sampler_type == "bilinear"
+    grid = grid_generator(loc, "affine", tuple(target_shape))
+    return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale: float = 1.0):
+    """Max-pool each ROI to a fixed grid (reference
+    src/operator/roi_pooling.cc).  rois (R, 5) = [batch_idx, x1, y1, x2, y2]
+    in image coords; bin boundaries floor/ceil exactly like the reference;
+    the per-bin max is a masked max over the feature map (static shapes; the
+    mask matmul trick keeps it jittable)."""
+    N, C, H, W = data.shape
+    PH, PW = pooled_size
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_h = rh / PH
+        bin_w = rw / PW
+        img = data[bidx]  # (C, H, W)
+
+        ph = jnp.arange(PH, dtype=jnp.float32)
+        pw = jnp.arange(PW, dtype=jnp.float32)
+        hstart = jnp.floor(ph * bin_h) + y1
+        hend = jnp.ceil((ph + 1) * bin_h) + y1
+        wstart = jnp.floor(pw * bin_w) + x1
+        wend = jnp.ceil((pw + 1) * bin_w) + x1
+        # bin membership masks: (PH, H) and (PW, W)
+        hm = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])
+        wm = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])
+        # masked max: (C, PH, PW)
+        big = jnp.finfo(data.dtype).min
+        masked = jnp.where(hm[None, :, None, :, None]
+                           & wm[None, None, :, None, :],
+                           img[:, None, None, :, :], big)
+        out = masked.max(axis=(3, 4))
+        empty = (~(hm.any(axis=1)))[None, :, None] \
+            | (~(wm.any(axis=1)))[None, None, :]
+        return jnp.where(empty, 0.0, out)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign",))
+def roi_align(data, rois, pooled_size=(1, 1), spatial_scale: float = 1.0,
+              sample_ratio: int = -1, position_sensitive: bool = False):
+    """ROIAlign (reference src/operator/contrib/roi_align.cc:52-77):
+    average of bilinear samples on a regular in-bin grid.
+
+    Deviation: the reference picks the sample-grid size adaptively
+    (ceil(roi_size/pooled)) when sample_ratio <= 0; adaptive counts are
+    data-dependent shapes, so here sample_ratio <= 0 uses a fixed 2x2 grid
+    per bin (the common detectron setting).  position_sensitive pooling is
+    not implemented.
+    """
+    if position_sensitive:
+        raise NotImplementedError("position_sensitive ROIAlign")
+    PH, PW = pooled_size
+    sr = sample_ratio if sample_ratio > 0 else 2
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_h = rh / PH
+        bin_w = rw / PW
+        # sample positions: (PH*sr,) x (PW*sr,)
+        iy = jnp.arange(PH * sr, dtype=jnp.float32)
+        ix = jnp.arange(PW * sr, dtype=jnp.float32)
+        py = y1 + (iy + 0.5) * bin_h / sr
+        px = x1 + (ix + 0.5) * bin_w / sr
+        pyg, pxg = jnp.meshgrid(py, px, indexing="ij")
+        vals = _bilinear_gather(data[bidx], pyg, pxg)  # (C, PH*sr, PW*sr)
+        C = vals.shape[0]
+        vals = vals.reshape(C, PH, sr, PW, sr)
+        return vals.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# resize / adaptive pooling
+# ---------------------------------------------------------------------------
+
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def bilinear_resize_2d(data, height: int = 1, width: int = 1,
+                       scale_height=None, scale_width=None):
+    """Reference src/operator/contrib/bilinear_resize.cc (align_corners
+    convention: src = dst * (in-1)/(out-1))."""
+    N, C, H, W = data.shape
+    OH = int(round(H * scale_height)) if scale_height else height
+    OW = int(round(W * scale_width)) if scale_width else width
+    sy = (H - 1) / (OH - 1) if OH > 1 else 0.0
+    sx = (W - 1) / (OW - 1) if OW > 1 else 0.0
+    py = jnp.arange(OH, dtype=jnp.float32) * sy
+    px = jnp.arange(OW, dtype=jnp.float32) * sx
+    pyg, pxg = jnp.meshgrid(py, px, indexing="ij")
+    return jax.vmap(lambda img: _bilinear_gather(img, pyg, pxg))(data)
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, output_size=None):
+    """Reference src/operator/contrib/adaptive_avg_pooling.cc: mean over
+    adaptive bins [floor(i*H/OH), ceil((i+1)*H/OH)).  Bins become two
+    averaging matrices so the whole op is two matmuls (MXU-friendly)."""
+    N, C, H, W = data.shape
+    if not output_size:
+        OH = OW = 1
+    elif isinstance(output_size, int):
+        OH = OW = output_size
+    else:
+        OH, OW = output_size if len(output_size) == 2 \
+            else (output_size[0],) * 2
+
+    def avg_matrix(out_d, in_d):
+        i = jnp.arange(out_d)
+        start = jnp.floor(i * in_d / out_d)
+        end = jnp.ceil((i + 1) * in_d / out_d)
+        idx = jnp.arange(in_d, dtype=jnp.float32)
+        m = ((idx[None, :] >= start[:, None])
+             & (idx[None, :] < end[:, None])).astype(data.dtype)
+        return m / m.sum(axis=1, keepdims=True)
+
+    mh = avg_matrix(OH, H)  # (OH, H)
+    mw = avg_matrix(OW, W)  # (OW, W)
+    # full precision: these matmuls ARE the averaging arithmetic
+    return jnp.einsum("oh,nchw,pw->ncop", mh, data, mw,
+                      precision=lax.Precision.HIGHEST)
+
+
+# ---------------------------------------------------------------------------
+# bounding-box ops (reference src/operator/contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    # center (x, y, w, h) -> corner
+    x, y, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                           axis=-1)
+
+
+def _box_iou_corner(a, b):
+    """IoU of two corner-format box arrays broadcast on leading dims."""
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) \
+        * jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) \
+        * jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format: str = "corner"):
+    """Pairwise IoU (reference bounding_box.cc:117): lhs (..., N, 4),
+    rhs (..., M, 4) -> (..., N, M)."""
+    a = _to_corner(lhs, format)
+    b = _to_corner(rhs, format)
+    return _box_iou_corner(a[..., :, None, :], b[..., None, :, :])
+
+
+@register("_contrib_box_nms", num_outputs=1, aliases=("box_nms",))
+def box_nms(data, overlap_thresh: float = 0.5, valid_thresh: float = 0.0,
+            topk: int = -1, coord_start: int = 2, score_index: int = 1,
+            id_index: int = -1, background_id: int = -1,
+            force_suppress: bool = False, in_format: str = "corner",
+            out_format: str = "corner"):
+    """Greedy non-maximum suppression (reference bounding_box.cc:36,
+    params bounding_box-inl.h:59-82).  Output keeps the score-sorted order
+    with suppressed/invalid entries set to -1, like the reference.
+
+    TPU-native: boxes are score-sorted, the full IoU matrix is computed
+    once, and the sequential suppression sweep is a lax.scan over rows —
+    static shapes, no host round-trips.
+    """
+    shape = data.shape
+    x = data.reshape((-1,) + shape[-2:])  # (B, N, K)
+    B, N, K = x.shape
+
+    def one_batch(batch):
+        scores = batch[:, score_index]
+        valid = scores > valid_thresh
+        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))
+        sorted_boxes = batch[order]
+        sorted_valid = valid[order]
+        if 0 < topk < N:
+            sorted_valid = sorted_valid & (jnp.arange(N) < topk)
+        corners = _to_corner(
+            sorted_boxes[:, coord_start:coord_start + 4], in_format)
+        iou = _box_iou_corner(corners[:, None, :], corners[None, :, :])
+        if id_index >= 0:
+            cls = sorted_boxes[:, id_index]
+            same_cls = cls[:, None] == cls[None, :]
+            if not force_suppress:
+                iou = jnp.where(same_cls, iou, 0.0)
+            if background_id >= 0:
+                not_bg = cls != background_id
+                sorted_valid = sorted_valid & not_bg
+
+        def body(alive, i):
+            keep_i = alive[i] & sorted_valid[i]
+            suppress = keep_i & (iou[i] > overlap_thresh) \
+                & (jnp.arange(N) > i)
+            return alive & ~suppress, keep_i
+
+        alive0 = jnp.ones(N, bool)
+        _, kept = lax.scan(body, alive0, jnp.arange(N))
+        out = jnp.where(kept[:, None], sorted_boxes, -1.0)
+        if out_format != in_format:
+            coords = out[:, coord_start:coord_start + 4]
+            conv = _to_corner(coords, in_format) if out_format == "corner" \
+                else None
+            if conv is None:  # corner -> center
+                x1, y1, x2, y2 = jnp.split(coords, 4, axis=-1)
+                conv = jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2,
+                                        x2 - x1, y2 - y1], axis=-1)
+            out = out.at[:, coord_start:coord_start + 4].set(
+                jnp.where(kept[:, None], conv, -1.0))
+        return out
+
+    return jax.vmap(one_batch)(x).reshape(shape)
+
+
+@register("_contrib_bipartite_matching", num_outputs=2,
+          aliases=("bipartite_matching",))
+def bipartite_matching(data, is_ascend: bool = False, threshold: float = 0.5,
+                       topk: int = -1):
+    """Greedy bipartite matching (reference bounding_box.cc
+    _contrib_bipartite_matching): data (..., N, M) pairwise scores ->
+    (row_match (..., N), col_match (..., M))."""
+    shape = data.shape
+    x = data.reshape((-1,) + shape[-2:])
+    B, N, M = x.shape
+    k = N if topk <= 0 else min(topk, N)
+
+    def one(mat):
+        big = jnp.inf if is_ascend else -jnp.inf
+
+        def body(carry, _):
+            m, row_m, col_m = carry
+            flat = m.ravel()
+            idx = jnp.argmin(flat) if is_ascend else jnp.argmax(flat)
+            val = flat[idx]
+            i, j = idx // M, idx % M
+            ok = (val < threshold) if is_ascend else (val > threshold)
+            row_m = jnp.where(ok, row_m.at[i].set(j.astype(jnp.float32)),
+                              row_m)
+            col_m = jnp.where(ok, col_m.at[j].set(i.astype(jnp.float32)),
+                              col_m)
+            m = jnp.where(ok, m.at[i, :].set(big).at[:, j].set(big), m)
+            return (m, row_m, col_m), None
+
+        init = (mat, jnp.full((N,), -1.0), jnp.full((M,), -1.0))
+        (m, row_m, col_m), _ = lax.scan(body, init, None, length=k)
+        return row_m, col_m
+
+    rows, cols = jax.vmap(one)(x)
+    return (rows.reshape(shape[:-1]), cols.reshape(shape[:-2] + (M,)))
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip: bool = False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generation (reference
+    src/operator/contrib/multibox_prior.cc): per feature-map cell emit
+    S + R - 1 corner-format anchors; output (1, H*W*(S+R-1), 4)."""
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+    ws, hs = [], []
+    s0 = sizes[0]
+    for s in sizes:  # anchors with ratio 1
+        ws.append(s / 2.0)
+        hs.append(s / 2.0)
+    for r in ratios[1:]:  # first ratio duplicates sizes[0]
+        sr = jnp.sqrt(r)
+        ws.append(s0 * sr / 2.0)
+        hs.append(s0 / sr / 2.0)
+    ws = jnp.array(ws, jnp.float32)  # (A,)
+    hs = jnp.array(hs, jnp.float32)
+    x1 = cxg[..., None] - ws
+    y1 = cyg[..., None] - hs
+    x2 = cxg[..., None] + ws
+    y2 = cyg[..., None] + hs
+    out = jnp.stack([x1, y1, x2, y2], axis=-1)  # (H, W, A, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.reshape(1, -1, 4)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet; reference src/operator/correlation.cc)
+# ---------------------------------------------------------------------------
+
+@register("Correlation", aliases=("correlation",))
+def correlation(data1, data2, kernel_size: int = 1,
+                max_displacement: int = 1, stride1: int = 1,
+                stride2: int = 1, pad_size: int = 0,
+                is_multiply: bool = True):
+    """Patch cross-correlation between two feature maps (reference
+    src/operator/correlation-inl.h InferShape + correlation.cc kernels).
+
+    Each of the D*D displacements (D = 2*(max_displacement//stride2)+1) is
+    one shifted elementwise product + box-sum — a static Python loop that
+    XLA fuses; output (N, D*D, OH, OW), normalized by K*K*C.
+    """
+    N, C, H, W = data1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    Hp, Wp = H + 2 * pad_size, W + 2 * pad_size
+    OH = -(-(Hp - 2 * border) // stride1)
+    OW = -(-(Wp - 2 * border) // stride1)
+    ngr = max_displacement // stride2
+    D = 2 * ngr + 1
+    m = max_displacement  # shift margin; windows anchor at border, not
+    # at ngr*stride2 (they differ when stride2 doesn't divide it)
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad_size + m, pad_size + m),
+                         (pad_size + m, pad_size + m)))
+    norm = float(kernel_size * kernel_size * C)
+    # first output window starts at border - kr = max_displacement
+    bstart = border - kr
+    outs = []
+    for dy in range(-ngr, ngr + 1):
+        for dx in range(-ngr, ngr + 1):
+            oy, ox = dy * stride2, dx * stride2
+            shifted = lax.dynamic_slice(
+                p2, (0, 0, m + oy, m + ox), (N, C, Hp, Wp))
+            prod = p1 * shifted if is_multiply \
+                else jnp.abs(p1 - shifted)
+            s = prod.sum(axis=1)  # (N, Hp, Wp)
+            box = lax.reduce_window(
+                s, 0.0, lax.add, (1, kernel_size, kernel_size),
+                (1, 1, 1), "valid")  # (N, Hp-K+1, Wp-K+1)
+            sl = box[:, bstart:bstart + (OH - 1) * stride1 + 1:stride1,
+                     bstart:bstart + (OW - 1) * stride1 + 1:stride1]
+            outs.append(sl / norm)
+    return jnp.stack(outs, axis=1)

@@ -1,10 +1,10 @@
 """Optimizers (reference ``python/mxnet/optimizer/``)."""
 from .optimizer import (  # noqa: F401
     Optimizer, Updater, get_updater, create, register,
-    SGD, Signum, FTML, LBSGD, DCASGD, NAG, SGLD, Adam, AdaGrad, RMSProp,
+    SGD, Signum, FTML, LBSGD, DCASGD, NAG, SGLD, Adam, AdamW, AdaGrad, RMSProp,
     AdaDelta, Ftrl, Adamax, Nadam, Test,
 )
 
 __all__ = ["Optimizer", "Updater", "get_updater", "create", "register",
-           "SGD", "Signum", "FTML", "LBSGD", "DCASGD", "NAG", "SGLD", "Adam",
+           "SGD", "Signum", "FTML", "LBSGD", "DCASGD", "NAG", "SGLD", "Adam", "AdamW",
            "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Test"]

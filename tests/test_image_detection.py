@@ -1,0 +1,183 @@
+"""Detection augmenter + ImageDetIter tests (reference
+``tests/python/unittest/test_image.py`` detection sections)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image
+
+
+def _img(h=40, w=60):
+    rs = onp.random.RandomState(0)
+    return mx.nd.array(rs.randint(0, 255, (h, w, 3)).astype("uint8"))
+
+
+def _label():
+    # rows: [cls, x1, y1, x2, y2]
+    return onp.array([[0, 0.2, 0.2, 0.6, 0.7],
+                      [1, 0.5, 0.1, 0.9, 0.4]], "float32")
+
+
+def test_det_borrow_aug():
+    aug = image.DetBorrowAug(image.CastAug())
+    src, label = aug(_img(), _label())
+    assert src.dtype == onp.float32
+    assert onp.allclose(label, _label())
+
+
+def test_det_horizontal_flip():
+    import random
+    random.seed(0)
+    aug = image.DetHorizontalFlipAug(1.0)  # always flip
+    x = _img()
+    src, label = aug(x, _label())
+    # image flipped
+    assert onp.allclose(src.asnumpy(), x.asnumpy()[:, ::-1])
+    # x coords mirrored: new_x1 = 1 - old_x2, new_x2 = 1 - old_x1
+    want = _label()
+    want[:, (1, 3)] = 1.0 - want[:, (3, 1)]
+    assert onp.allclose(label, want, atol=1e-6)
+    # y coords untouched
+    assert onp.allclose(label[:, (2, 4)], _label()[:, (2, 4)])
+
+
+def test_det_random_crop_labels_consistent():
+    import random
+    random.seed(42)
+    aug = image.DetRandomCropAug(min_object_covered=0.1,
+                                 area_range=(0.1, 1.0), max_attempts=50)
+    for _ in range(10):
+        src, label = aug(_img(), _label())
+        # all surviving labels stay normalized and well-formed
+        assert (label[:, 1:5] >= 0).all() and (label[:, 1:5] <= 1).all()
+        assert (label[:, 3] > label[:, 1]).all()
+        assert (label[:, 4] > label[:, 2]).all()
+        assert label.shape[0] >= 1
+
+
+def test_det_random_pad_labels_consistent():
+    import random
+    random.seed(1)
+    aug = image.DetRandomPadAug(area_range=(1.5, 3.0))
+    x = _img()
+    src, label = aug(x, _label())
+    h, w = src.shape[:2]
+    assert h >= 40 and w >= 60 and (h > 40 or w > 60)
+    # boxes shrink: areas in the padded frame must be <= original
+    assert ((label[:, 3] - label[:, 1])
+            <= (_label()[:, 3] - _label()[:, 1]) + 1e-6).all()
+
+
+def test_det_random_pad_min_area():
+    import random
+    random.seed(2)
+    aug = image.DetRandomPadAug(area_range=(2.0, 3.0),
+                                aspect_ratio_range=(1.0, 1.0))
+    for _ in range(10):
+        src, _ = aug(_img(), _label())
+        h, w = src.shape[:2]
+        # canvas must honor the minimum area expansion
+        assert h * w >= 2.0 * 40 * 60 * 0.9, (h, w)
+
+
+def test_det_random_select_skip():
+    aug = image.DetRandomSelectAug(
+        [image.DetHorizontalFlipAug(1.0)], skip_prob=1.0)
+    x = _img()
+    src, label = aug(x, _label())
+    assert onp.allclose(src.asnumpy(), x.asnumpy())
+
+
+def test_create_det_augmenter():
+    augs = image.CreateDetAugmenter((3, 30, 30), rand_crop=0.5,
+                                    rand_pad=0.5, rand_mirror=True,
+                                    mean=True, std=True, brightness=0.1,
+                                    hue=0.1, rand_gray=0.1)
+    assert len(augs) > 4
+    src, label = _img(), _label()
+    for aug in augs:
+        src, label = aug(src, label)
+    assert src.shape[:2] == (30, 30)
+    assert label.shape[1] == 5
+
+
+def test_multi_rand_crop_augmenter_aligns_params():
+    aug = image.CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5], area_range=(0.1, 1.0))
+    assert len(aug.aug_list) == 2
+    assert aug.aug_list[1].min_object_covered == 0.5
+
+
+def _write_det_dataset(tmpdir, n=6):
+    cv2 = pytest.importorskip("cv2")
+    imglist = []
+    rs = onp.random.RandomState(3)
+    for i in range(n):
+        fname = "img%d.png" % i
+        cv2.imwrite(os.path.join(str(tmpdir), fname),
+                    rs.randint(0, 255, (32 + i, 48, 3)).astype("uint8"))
+        # header: [header_width=2, obj_width=5], then i%2+1 objects
+        objs = []
+        for j in range(i % 2 + 1):
+            objs += [float(j), 0.1, 0.1, 0.6, 0.7]
+        imglist.append([[2.0, 5.0] + objs, fname])
+    return imglist
+
+
+def test_image_det_iter(tmp_path):
+    imglist = _write_det_dataset(tmp_path)
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                            imglist=imglist, path_root=str(tmp_path),
+                            aug_list=image.CreateDetAugmenter((3, 24, 24)))
+    # label shape estimated from the dataset: max 2 objects, width 5
+    assert it.label_shape == (2, 5)
+    assert it.provide_label[0].shape == (2, 2, 5)
+    batches = list(it)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b.data[0].shape == (2, 3, 24, 24)
+    assert b.label[0].shape == (2, 2, 5)
+    lab = b.label[0].asnumpy()
+    # single-object samples padded with -1 rows
+    assert (lab[0, 1] == -1).all()
+    # iterate again after reset
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_det_iter_reshape(tmp_path):
+    imglist = _write_det_dataset(tmp_path)
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                            imglist=imglist, path_root=str(tmp_path),
+                            aug_list=image.CreateDetAugmenter((3, 24, 24)))
+    it.reshape(label_shape=(5, 5))
+    assert it.provide_label[0].shape == (2, 5, 5)
+    b = next(it)
+    assert b.label[0].shape == (2, 5, 5)
+    with pytest.raises(ValueError):
+        it.check_label_shape((1, 5))
+    with pytest.raises(ValueError):
+        it.check_label_shape((5, 6))
+
+
+def test_hue_and_gray_augs():
+    import random
+    random.seed(0)
+    x = _img()
+    out = image.HueJitterAug(0.5)(x)
+    assert out.shape == x.shape
+    gray = image.RandomGrayAug(1.0)(x)
+    g = gray.asnumpy()
+    assert onp.allclose(g[..., 0], g[..., 1], atol=1e-4)
+    assert onp.allclose(g[..., 1], g[..., 2], atol=1e-4)
+
+
+def test_copy_make_border():
+    x = _img(4, 5)
+    out = image.copyMakeBorder(x, 1, 2, 3, 4, values=(7, 8, 9))
+    assert out.shape == (7, 12, 3)
+    o = out.asnumpy()
+    assert (o[0, 0] == [7, 8, 9]).all()
+    assert onp.allclose(o[1:5, 3:8], x.asnumpy())

@@ -626,6 +626,21 @@ COVERED_ELSEWHERE = {
     # tested in tests/test_gluon_contrib.py (layer-level value checks)
     "_contrib_SyncBatchNorm", "SyncBatchNorm",
     "_contrib_DeformableConvolution", "DeformableConvolution",
+    # tested in tests/test_vision_ops.py (golden-value checks)
+    "BilinearSampler", "bilinear_sampler", "GridGenerator",
+    "grid_generator", "SpatialTransformer", "spatial_transformer",
+    "ROIPooling", "roi_pooling", "_contrib_ROIAlign", "ROIAlign",
+    "_contrib_BilinearResize2D", "BilinearResize2D",
+    "_contrib_AdaptiveAvgPooling2D", "AdaptiveAvgPooling2D",
+    "_contrib_box_iou", "box_iou", "_contrib_box_nms", "box_nms",
+    "_contrib_bipartite_matching", "bipartite_matching",
+    "_contrib_MultiBoxPrior", "MultiBoxPrior", "Correlation", "correlation",
+    "_contrib_div_sqrt_dim", "div_sqrt_dim", "_contrib_quadratic",
+    "quadratic", "_contrib_index_array", "index_array",
+    "_contrib_index_copy", "index_copy", "_contrib_fft", "fft",
+    "_contrib_ifft", "ifft", "_contrib_count_sketch", "count_sketch",
+    "_contrib_gradient_multiplier", "gradient_multiplier",
+    "all_finite", "multi_all_finite",
     # aliases of tested canonical ops
     "activation", "batch_norm", "convolution", "deconvolution", "dropout",
     "fully_connected", "layer_norm", "linear_regression_output",

@@ -1,0 +1,460 @@
+"""Vision + contrib operator tests (reference
+``tests/python/unittest/test_operator.py`` vision sections and
+``test_contrib_operator.py``)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _arr(a):
+    return mx.nd.array(onp.asarray(a, "float32"))
+
+
+# ---------------------------------------------------------------------------
+# sampling ops
+# ---------------------------------------------------------------------------
+
+def test_bilinear_sampler_identity():
+    x = onp.random.RandomState(0).rand(2, 3, 5, 7).astype("float32")
+    H, W = 5, 7
+    ys = onp.linspace(-1, 1, H)
+    xs = onp.linspace(-1, 1, W)
+    gx, gy = onp.meshgrid(xs, ys)
+    grid = onp.stack([gx, gy])[None].repeat(2, 0).astype("float32")
+    out = mx.nd.BilinearSampler(_arr(x), _arr(grid)).asnumpy()
+    assert onp.allclose(out, x, atol=1e-5)
+
+
+def test_bilinear_sampler_halfpixel_shift():
+    # shifting the grid by one pixel left reads the next column
+    x = onp.arange(2 * 1 * 3 * 4, dtype="float32").reshape(2, 1, 3, 4)
+    ys = onp.linspace(-1, 1, 3)
+    xs = onp.linspace(-1, 1, 4) + 2.0 / 3  # +1 pixel in x
+    gx, gy = onp.meshgrid(xs, ys)
+    grid = onp.stack([gx, gy])[None].repeat(2, 0).astype("float32")
+    out = mx.nd.BilinearSampler(_arr(x), _arr(grid)).asnumpy()
+    assert onp.allclose(out[:, :, :, :-1], x[:, :, :, 1:], atol=1e-4)
+    # out-of-range reads are zero-padded
+    assert onp.allclose(out[:, :, :, -1], 0.0, atol=1e-4)
+
+
+def test_grid_generator_affine_identity():
+    theta = onp.array([[1, 0, 0, 0, 1, 0]], "float32")
+    grid = mx.nd.GridGenerator(_arr(theta), transform_type="affine",
+                               target_shape=(3, 4)).asnumpy()
+    ys = onp.linspace(-1, 1, 3)
+    xs = onp.linspace(-1, 1, 4)
+    gx, gy = onp.meshgrid(xs, ys)
+    assert onp.allclose(grid[0, 0], gx, atol=1e-6)
+    assert onp.allclose(grid[0, 1], gy, atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = onp.zeros((1, 2, 3, 4), "float32")
+    grid = mx.nd.GridGenerator(_arr(flow), transform_type="warp").asnumpy()
+    ys = onp.linspace(-1, 1, 3)
+    xs = onp.linspace(-1, 1, 4)
+    gx, gy = onp.meshgrid(xs, ys)
+    assert onp.allclose(grid[0, 0], gx, atol=1e-6)
+    assert onp.allclose(grid[0, 1], gy, atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    x = onp.random.RandomState(1).rand(2, 3, 6, 6).astype("float32")
+    theta = onp.tile(onp.array([1, 0, 0, 0, 1, 0], "float32"), (2, 1))
+    out = mx.nd.SpatialTransformer(_arr(x), _arr(theta),
+                                   target_shape=(6, 6)).asnumpy()
+    assert onp.allclose(out, x, atol=1e-5)
+
+
+def test_spatial_transformer_grad():
+    x = _arr(onp.random.rand(1, 2, 5, 5))
+    theta = _arr([[1, 0, 0.1, 0, 1, -0.1]])
+    x.attach_grad()
+    theta.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.SpatialTransformer(x, theta, target_shape=(5, 5))
+        loss = (out ** 2).sum()
+    loss.backward()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+    assert onp.abs(theta.grad.asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+def _roi_pool_ref(data, rois, pooled, scale):
+    R = rois.shape[0]
+    N, C, H, W = data.shape
+    PH, PW = pooled
+    out = onp.zeros((R, C, PH, PW), "float32")
+    for r in range(R):
+        b = int(rois[r, 0])
+        x1, y1, x2, y2 = onp.round(rois[r, 1:] * scale)
+        rw = max(x2 - x1 + 1, 1.0)
+        rh = max(y2 - y1 + 1, 1.0)
+        for ph in range(PH):
+            for pw_ in range(PW):
+                hs = int(onp.floor(ph * rh / PH) + y1)
+                he = int(onp.ceil((ph + 1) * rh / PH) + y1)
+                ws = int(onp.floor(pw_ * rw / PW) + x1)
+                we = int(onp.ceil((pw_ + 1) * rw / PW) + x1)
+                hs, he = max(hs, 0), min(he, H)
+                ws, we = max(ws, 0), min(we, W)
+                if hs >= he or ws >= we:
+                    continue
+                out[r, :, ph, pw_] = data[b, :, hs:he, ws:we].max(axis=(1, 2))
+    return out
+
+
+def test_roi_pooling_matches_naive():
+    rs = onp.random.RandomState(2)
+    data = rs.rand(2, 3, 12, 12).astype("float32")
+    rois = onp.array([[0, 0, 0, 7, 7], [1, 2, 2, 9, 11], [0, 5, 3, 11, 11]],
+                     "float32")
+    got = mx.nd.ROIPooling(_arr(data), _arr(rois), pooled_size=(3, 3),
+                           spatial_scale=1.0).asnumpy()
+    want = _roi_pool_ref(data, rois, (3, 3), 1.0)
+    assert onp.allclose(got, want, atol=1e-5), onp.abs(got - want).max()
+
+
+def test_roi_pooling_spatial_scale():
+    rs = onp.random.RandomState(3)
+    data = rs.rand(1, 2, 8, 8).astype("float32")
+    rois = onp.array([[0, 0, 0, 15, 15]], "float32")  # full image at 1/2
+    got = mx.nd.ROIPooling(_arr(data), _arr(rois), pooled_size=(2, 2),
+                           spatial_scale=0.5).asnumpy()
+    want = _roi_pool_ref(data, rois, (2, 2), 0.5)
+    assert onp.allclose(got, want, atol=1e-5)
+
+
+def test_roi_align_matches_naive():
+    rs = onp.random.RandomState(4)
+    data = rs.rand(1, 2, 10, 10).astype("float32")
+    rois = onp.array([[0, 1.0, 1.0, 8.0, 8.0]], "float32")
+    PH = PW = sr = 2
+    got = mx.nd._contrib_ROIAlign(_arr(data), _arr(rois),
+                                  pooled_size=(PH, PW), spatial_scale=1.0,
+                                  sample_ratio=sr).asnumpy()
+
+    def bil(img, y, x):
+        H, W = img.shape[1:]
+        y0, x0 = int(onp.floor(y)), int(onp.floor(x))
+        wy, wx = y - y0, x - x0
+        val = 0
+        for dy, fy in ((0, 1 - wy), (1, wy)):
+            for dx, fx in ((0, 1 - wx), (1, wx)):
+                yy, xx = y0 + dy, x0 + dx
+                if 0 <= yy < H and 0 <= xx < W:
+                    val += fy * fx * img[:, yy, xx]
+        return val
+
+    x1, y1, x2, y2 = rois[0, 1:]
+    rw, rh = max(x2 - x1, 1), max(y2 - y1, 1)
+    want = onp.zeros((1, 2, PH, PW), "float32")
+    for ph in range(PH):
+        for pw_ in range(PW):
+            acc = 0
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 + (ph * sr + iy + 0.5) * rh / (PH * sr)
+                    x = x1 + (pw_ * sr + ix + 0.5) * rw / (PW * sr)
+                    acc = acc + bil(data[0], y, x)
+            want[0, :, ph, pw_] = acc / (sr * sr)
+    assert onp.allclose(got, want, atol=1e-4), onp.abs(got - want).max()
+
+
+# ---------------------------------------------------------------------------
+# resize / adaptive pool (cross-checked against torch)
+# ---------------------------------------------------------------------------
+
+def test_bilinear_resize_2d_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    x = onp.random.RandomState(5).rand(2, 3, 7, 9).astype("float32")
+    got = mx.nd._contrib_BilinearResize2D(_arr(x), height=14,
+                                          width=5).asnumpy()
+    want = F.interpolate(torch.from_numpy(x), size=(14, 5), mode="bilinear",
+                         align_corners=True).numpy()
+    assert onp.allclose(got, want, atol=1e-4)
+
+
+def test_adaptive_avg_pooling_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    x = onp.random.RandomState(6).rand(2, 4, 11, 7).astype("float32")
+    got = mx.nd._contrib_AdaptiveAvgPooling2D(
+        _arr(x), output_size=(3, 4)).asnumpy()
+    want = F.adaptive_avg_pool2d(torch.from_numpy(x), (3, 4)).numpy()
+    assert onp.allclose(got, want, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# bounding-box ops
+# ---------------------------------------------------------------------------
+
+def test_box_iou():
+    a = onp.array([[0, 0, 2, 2]], "float32")
+    b = onp.array([[1, 1, 3, 3], [4, 4, 5, 5]], "float32")
+    got = mx.nd._contrib_box_iou(_arr(a), _arr(b)).asnumpy()
+    assert onp.allclose(got, [[1.0 / 7, 0.0]], atol=1e-5)
+
+
+def test_box_iou_center_format():
+    a = onp.array([[1, 1, 2, 2]], "float32")  # center -> [0,0,2,2]
+    b = onp.array([[2, 2, 2, 2]], "float32")  # center -> [1,1,3,3]
+    got = mx.nd._contrib_box_iou(_arr(a), _arr(b),
+                                 format="center").asnumpy()
+    assert onp.allclose(got, [[1.0 / 7]], atol=1e-5)
+
+
+def test_box_nms_reference_example():
+    """The documented example at reference bounding_box.cc:83."""
+    x = onp.array([[0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                   [1, 0.4, 0.1, 0.1, 0.2, 0.2],
+                   [0, 0.3, 0.1, 0.1, 0.14, 0.14],
+                   [2, 0.6, 0.5, 0.5, 0.7, 0.8]], "float32")
+    out = mx.nd._contrib_box_nms(_arr(x), overlap_thresh=0.1,
+                                 coord_start=2, score_index=1, id_index=0,
+                                 force_suppress=True).asnumpy()
+    want = onp.array([[2, 0.6, 0.5, 0.5, 0.7, 0.8],
+                      [0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                      [-1, -1, -1, -1, -1, -1],
+                      [-1, -1, -1, -1, -1, -1]], "float32")
+    assert onp.allclose(out, want, atol=1e-5), out
+
+
+def test_box_nms_per_class():
+    # without force_suppress, different ids don't suppress each other
+    x = onp.array([[0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                   [1, 0.4, 0.1, 0.1, 0.2, 0.2]], "float32")
+    out = mx.nd._contrib_box_nms(_arr(x), overlap_thresh=0.1,
+                                 coord_start=2, score_index=1,
+                                 id_index=0).asnumpy()
+    assert (out[1] != -1).all()
+
+
+def test_box_nms_valid_thresh_and_batch():
+    x = onp.zeros((2, 3, 5), "float32")
+    x[0, 0] = [0.9, 0, 0, 1, 1]
+    x[0, 1] = [0.0, 0, 0, 1, 1]       # below valid_thresh
+    x[0, 2] = [0.8, 2, 2, 3, 3]       # no overlap, kept
+    x[1, 0] = [0.7, 0, 0, 1, 1]
+    out = mx.nd._contrib_box_nms(_arr(x), overlap_thresh=0.5,
+                                 valid_thresh=0.01, coord_start=1,
+                                 score_index=0).asnumpy()
+    assert onp.allclose(out[0, 0], [0.9, 0, 0, 1, 1])
+    assert onp.allclose(out[0, 1], [0.8, 2, 2, 3, 3])
+    assert (out[0, 2] == -1).all()
+    assert onp.allclose(out[1, 0], [0.7, 0, 0, 1, 1])
+
+
+def test_bipartite_matching():
+    score = onp.array([[[0.9, 0.1], [0.8, 0.2]]], "float32")
+    rows, cols = mx.nd._contrib_bipartite_matching(_arr(score),
+                                                   threshold=0.05)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: (0,0)=0.9 first, then (1,1)=0.2
+    assert rows[0].tolist() == [0.0, 1.0]
+    assert cols[0].tolist() == [0.0, 1.0]
+
+
+def test_multibox_prior():
+    data = mx.nd.zeros((1, 3, 2, 2))
+    anchors = mx.nd._contrib_MultiBoxPrior(
+        data, sizes=(0.5, 0.25), ratios=(1.0, 2.0)).asnumpy()
+    assert anchors.shape == (1, 2 * 2 * 3, 4)
+    # first cell center is (0.25, 0.25); first anchor size 0.5
+    assert onp.allclose(anchors[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-5)
+    # ratio-2 anchor: w = s0*sqrt(2), h = s0/sqrt(2)
+    w = anchors[0, 2, 2] - anchors[0, 2, 0]
+    h = anchors[0, 2, 3] - anchors[0, 2, 1]
+    assert onp.allclose(w / h, 2.0, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# correlation
+# ---------------------------------------------------------------------------
+
+def _corr_ref(d1, d2, K, md, s1, s2, pad, mult):
+    N, C, H, W = d1.shape
+    kr = (K - 1) // 2
+    border = md + kr
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    OH = -(-(Hp - 2 * border) // s1)
+    OW = -(-(Wp - 2 * border) // s1)
+    ngr = md // s2
+    D = 2 * ngr + 1
+    p1 = onp.zeros((N, C, Hp, Wp), "float32")
+    p1[:, :, pad:pad + H, pad:pad + W] = d1
+    p2 = onp.zeros((N, C, Hp, Wp), "float32")
+    p2[:, :, pad:pad + H, pad:pad + W] = d2
+    out = onp.zeros((N, D * D, OH, OW), "float32")
+    for n in range(N):
+        for i, dy in enumerate(range(-ngr, ngr + 1)):
+            for j, dx in enumerate(range(-ngr, ngr + 1)):
+                for oy in range(OH):
+                    for ox in range(OW):
+                        y1 = oy * s1 + border
+                        x1 = ox * s1 + border
+                        acc = 0.0
+                        for ky in range(-kr, kr + 1):
+                            for kx in range(-kr, kr + 1):
+                                a = p1[n, :, y1 + ky, x1 + kx]
+                                yy = y1 + ky + dy * s2
+                                xx = x1 + kx + dx * s2
+                                if 0 <= yy < Hp and 0 <= xx < Wp:
+                                    b = p2[n, :, yy, xx]
+                                else:
+                                    b = 0.0
+                                acc += (a * b).sum() if mult \
+                                    else onp.abs(a - b).sum()
+                        out[n, i * D + j, oy, ox] = acc / (K * K * C)
+    return out
+
+
+@pytest.mark.parametrize("mult", [True, False])
+def test_correlation_matches_naive(mult):
+    rs = onp.random.RandomState(7)
+    d1 = rs.rand(1, 2, 6, 6).astype("float32")
+    d2 = rs.rand(1, 2, 6, 6).astype("float32")
+    got = mx.nd.Correlation(_arr(d1), _arr(d2), kernel_size=3,
+                            max_displacement=1, stride1=1, stride2=1,
+                            pad_size=2, is_multiply=mult).asnumpy()
+    want = _corr_ref(d1, d2, 3, 1, 1, 1, 2, mult)
+    assert got.shape == want.shape
+    assert onp.allclose(got, want, atol=1e-4), onp.abs(got - want).max()
+
+
+@pytest.mark.parametrize("K,md,s1,s2,pad", [
+    (1, 3, 1, 2, 3),   # stride2 does NOT divide max_displacement
+    (1, 2, 2, 1, 2),   # strided output
+    (3, 2, 1, 2, 3),
+])
+def test_correlation_param_grid(K, md, s1, s2, pad):
+    rs = onp.random.RandomState(11)
+    d1 = rs.rand(1, 2, 8, 8).astype("float32")
+    d2 = rs.rand(1, 2, 8, 8).astype("float32")
+    got = mx.nd.Correlation(_arr(d1), _arr(d2), kernel_size=K,
+                            max_displacement=md, stride1=s1, stride2=s2,
+                            pad_size=pad, is_multiply=True).asnumpy()
+    want = _corr_ref(d1, d2, K, md, s1, s2, pad, True)
+    assert got.shape == want.shape
+    assert onp.allclose(got, want, atol=1e-4), onp.abs(got - want).max()
+
+
+# ---------------------------------------------------------------------------
+# misc contrib ops
+# ---------------------------------------------------------------------------
+
+def test_div_sqrt_dim():
+    x = onp.random.rand(2, 8).astype("float32")
+    got = mx.nd._contrib_div_sqrt_dim(_arr(x)).asnumpy()
+    assert onp.allclose(got, x / onp.sqrt(8), atol=1e-6)
+
+
+def test_quadratic():
+    x = onp.array([1.0, 2.0, 3.0], "float32")
+    got = mx.nd._contrib_quadratic(_arr(x), a=2, b=3, c=4).asnumpy()
+    assert onp.allclose(got, 2 * x * x + 3 * x + 4)
+
+
+def test_quadratic_grad():
+    x = _arr([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd._contrib_quadratic(x, a=1, b=2, c=0).sum()
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), 2 * onp.array([1, 2]) + 2)
+
+
+def test_index_array():
+    x = mx.nd.zeros((2, 3))
+    idx = mx.nd._contrib_index_array(x).asnumpy()
+    assert idx.shape == (2, 3, 2)
+    assert idx[1, 2].tolist() == [1, 2]
+    idx = mx.nd._contrib_index_array(x, axes=(1,)).asnumpy()
+    assert idx[1, 2].tolist() == [2]
+
+
+def test_index_copy():
+    old = mx.nd.zeros((5, 3))
+    new = _arr(onp.ones((2, 3)))
+    idx = mx.nd.array(onp.array([1, 3], "float32"))
+    out = mx.nd._contrib_index_copy(old, idx, new).asnumpy()
+    assert out[1].tolist() == [1, 1, 1]
+    assert out[3].tolist() == [1, 1, 1]
+    assert out[0].tolist() == [0, 0, 0]
+
+
+def test_fft_ifft_roundtrip():
+    x = onp.random.RandomState(8).rand(3, 8).astype("float32")
+    f = mx.nd._contrib_fft(_arr(x))
+    assert f.shape == (3, 16)
+    # cuFFT-style unnormalized roundtrip: ifft(fft(x)) = x * d
+    back = mx.nd._contrib_ifft(f).asnumpy()
+    assert onp.allclose(back, x * 8, atol=1e-3)
+
+
+def test_fft_values():
+    x = onp.random.RandomState(9).rand(2, 4).astype("float32")
+    got = mx.nd._contrib_fft(_arr(x)).asnumpy()
+    ref = onp.fft.fft(x, axis=-1)
+    inter = onp.stack([ref.real, ref.imag], -1).reshape(2, 8)
+    assert onp.allclose(got, inter, atol=1e-4)
+
+
+def test_count_sketch():
+    x = onp.array([[1.0, 2.0, 3.0]], "float32")
+    h = onp.array([0, 1, 0], "float32")
+    s = onp.array([1, -1, 1], "float32")
+    got = mx.nd._contrib_count_sketch(_arr(x), _arr(h), _arr(s),
+                                      out_dim=2).asnumpy()
+    assert onp.allclose(got, [[4.0, -2.0]])
+
+
+def test_gradient_multiplier():
+    x = _arr([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd._contrib_gradient_multiplier(x, scalar=-0.5).sum()
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), [-0.5, -0.5])
+    # forward is identity
+    assert onp.allclose(
+        mx.nd._contrib_gradient_multiplier(x, scalar=-0.5).asnumpy(),
+        x.asnumpy())
+
+
+def test_all_finite():
+    ok = mx.nd.all_finite(_arr([1.0, 2.0])).asnumpy()
+    assert ok.tolist() == [1.0]
+    bad = mx.nd.all_finite(_arr([1.0, onp.inf])).asnumpy()
+    assert bad.tolist() == [0.0]
+    m = mx.nd.multi_all_finite(_arr([1.0]), _arr([onp.nan]),
+                               num_arrays=2).asnumpy()
+    assert m.tolist() == [0.0]
+
+
+def test_adamw_decoupled_decay():
+    """AdamW: wd is applied to the weight, not folded into the gradient."""
+    opt = mx.optimizer.AdamW(learning_rate=0.1, wd=0.1, eta=1.0)
+    w = _arr([1.0])
+    g = _arr([0.0])  # zero gradient: only decay acts
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    # m=v=0 with zero grad -> w' = w - eta*(wd*w) = 0.9
+    assert onp.allclose(w.asnumpy(), [0.9], atol=1e-6)
+
+    # nonzero grad matches the manual formula
+    opt2 = mx.optimizer.AdamW(learning_rate=0.1, wd=0.0)
+    w2 = _arr([1.0])
+    g2 = _arr([0.5])
+    st = opt2.create_state(0, w2)
+    opt2.update(0, w2, g2, st)
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    want = 1.0 - 0.1 * m / (onp.sqrt(v) + 1e-8)
+    assert onp.allclose(w2.asnumpy(), [want], atol=1e-6)
