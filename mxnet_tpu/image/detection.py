@@ -396,24 +396,35 @@ class ImageDetIter(ImageIter):
         if (raw.size - header_width) % obj_width != 0:
             raise RuntimeError("invalid label length %d" % raw.size)
         out = onp.reshape(raw[header_width:], (-1, obj_width))
-        if (out[:, 1:5] > 1.0).any() or (out[:, 1:5] < 0.0).any():
-            raise RuntimeError("label coordinates must be normalized")
+        # drop degenerate ground truths (xmax<=xmin or ymax<=ymin), like the
+        # reference; keep everything else — range is not validated there
+        keep = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        out = out[keep]
+        if out.shape[0] < 1:
+            raise RuntimeError("sample has no valid detection label")
         return out.astype("float32")
+
+    def _iter_raw_labels(self):
+        """Labels of every record WITHOUT decoding image payloads —
+        iterator construction must not JPEG-decode the whole dataset."""
+        if self.record is not None:
+            from ..recordio import unpack
+            for idx in self.seq:
+                header, _ = unpack(self.record.read_idx(idx))
+                yield header.label
+        else:
+            for idx in self.seq:
+                yield self.imglist[idx][0]
 
     def _estimate_label_shape(self):
         """Max object count across the dataset (reference
         detection.py:79)."""
         max_count = 0
         obj_width = 5
-        self.reset()
-        try:
-            while True:
-                label, _ = self.next_sample()
-                label = self._parse_label(label)
-                max_count = max(max_count, label.shape[0])
-                obj_width = label.shape[1]
-        except StopIteration:
-            pass
+        for label in self._iter_raw_labels():
+            label = self._parse_label(label)
+            max_count = max(max_count, label.shape[0])
+            obj_width = label.shape[1]
         self.reset()
         return (max_count, obj_width)
 

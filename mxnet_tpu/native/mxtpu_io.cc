@@ -1,0 +1,537 @@
+// mxtpu_io.cc — native data layer for the TPU framework.
+//
+// TPU-native equivalent of the reference's C++ I/O stack:
+//   * dmlc recordio framing        (reference src/io/ + recordio readers)
+//   * ImageRecordIter hot path     (reference src/io/iter_image_recordio_2.cc:
+//     OMP decode workers, prefetch, inline augmentation)
+//
+// Design: the .rec file is mmap'd (zero-copy record access); a pool of
+// worker threads each assembles WHOLE batches (JPEG decode via libjpeg,
+// bilinear resize, random/center crop, mirror, mean/std normalize, NCHW
+// float32) into recycled slot buffers; completed batches are delivered to
+// Python IN ORDER through a bounded queue.  The host→device copy then
+// happens on the Python side (jax.device_put double-buffering), so decode
+// for batch N+1 overlaps both compute and transfer of batch N — same
+// overlap structure the reference gets from its prefetcher + OMP decoders.
+//
+// Exposed as a C ABI consumed by ctypes (no pybind11 in this image).
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+// ---------------------------------------------------------------------------
+// mmap'd RecordIO reader
+// ---------------------------------------------------------------------------
+
+struct RecFile {
+  int fd = -1;
+  const uint8_t* base = nullptr;
+  uint64_t size = 0;
+};
+
+RecFile* rec_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (p == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* f = new RecFile();
+  f->fd = fd;
+  f->base = static_cast<const uint8_t*>(p);
+  f->size = static_cast<uint64_t>(st.st_size);
+  return f;
+}
+
+void rec_close(RecFile* f) {
+  if (!f) return;
+  if (f->base) munmap(const_cast<uint8_t*>(f->base), f->size);
+  if (f->fd >= 0) ::close(f->fd);
+  delete f;
+}
+
+// Record payload at a byte offset (dmlc framing: magic, lrec, payload, pad4).
+bool rec_at(const RecFile* f, uint64_t off, const uint8_t** data,
+            uint64_t* len) {
+  if (off + 8 > f->size) return false;
+  uint32_t magic, lrec;
+  std::memcpy(&magic, f->base + off, 4);
+  std::memcpy(&lrec, f->base + off + 4, 4);
+  if (magic != kMagic) return false;
+  uint64_t n = lrec & ((1u << 29) - 1);
+  if (off + 8 + n > f->size) return false;
+  *data = f->base + off + 8;
+  *len = n;
+  return true;
+}
+
+// IRHeader: uint32 flag, float label, uint64 id, uint64 id2 (24 bytes),
+// then `flag` float32 labels if flag > 0.  Matches python recordio.pack.
+struct IRView {
+  uint32_t flag;
+  float label;
+  const float* labels;  // nullptr unless flag > 0
+  const uint8_t* img;
+  uint64_t img_len;
+};
+
+bool ir_parse(const uint8_t* data, uint64_t len, IRView* out) {
+  if (len < 24) return false;
+  std::memcpy(&out->flag, data, 4);
+  std::memcpy(&out->label, data + 4, 4);
+  uint64_t skip = 24;
+  out->labels = nullptr;
+  if (out->flag > 0) {
+    skip += uint64_t(out->flag) * 4;
+    if (len < skip) return false;
+    out->labels = reinterpret_cast<const float*>(data + 24);
+  }
+  out->img = data + skip;
+  out->img_len = len - skip;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// JPEG decode (libjpeg) with setjmp error recovery
+// ---------------------------------------------------------------------------
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+// Decode to RGB u8 HWC; returns false on any decode error.
+bool jpeg_decode(const uint8_t* buf, uint64_t len, std::vector<uint8_t>* out,
+                 int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // libjpeg converts gray/CMYK for us
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(uint64_t(*h) * *w * 3);
+  uint64_t stride = uint64_t(*w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + uint64_t(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Bilinear resize, u8 RGB HWC
+// ---------------------------------------------------------------------------
+
+void resize_bilinear(const uint8_t* src, int sh, int sw, uint8_t* dst, int dh,
+                     int dw) {
+  const float sy = float(sh) / dh, sx = float(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = std::max(0, std::min(sh - 1, int(fy)));
+    int y1 = std::min(sh - 1, y0 + 1);
+    float wy = std::max(0.f, std::min(1.f, fy - y0));
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = std::max(0, std::min(sw - 1, int(fx)));
+      int x1 = std::min(sw - 1, x0 + 1);
+      float wx = std::max(0.f, std::min(1.f, fx - x0));
+      const uint8_t* p00 = src + (uint64_t(y0) * sw + x0) * 3;
+      const uint8_t* p01 = src + (uint64_t(y0) * sw + x1) * 3;
+      const uint8_t* p10 = src + (uint64_t(y1) * sw + x0) * 3;
+      const uint8_t* p11 = src + (uint64_t(y1) * sw + x1) * 3;
+      uint8_t* d = dst + (uint64_t(y) * dw + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float top = p00[c] + (p01[c] - p00[c]) * wx;
+        float bot = p10[c] + (p11[c] - p10[c]) * wx;
+        d[c] = uint8_t(top + (bot - top) * wy + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Per-record augment + normalize into an NCHW float32 slab
+// ---------------------------------------------------------------------------
+
+struct AugParams {
+  int out_h, out_w;
+  int resize_short;   // 0 = off
+  int rand_crop;      // else center crop
+  int rand_mirror;    // 50% hflip
+  float mean[3], std[3];
+};
+
+void process_record(const uint8_t* jpg, uint64_t len, const AugParams& ap,
+                    float* out, std::mt19937* rng, bool* ok) {
+  std::vector<uint8_t> img;
+  int h = 0, w = 0;
+  if (!jpeg_decode(jpg, len, &img, &h, &w)) {
+    std::fill(out, out + uint64_t(3) * ap.out_h * ap.out_w, 0.f);
+    *ok = false;
+    return;
+  }
+  *ok = true;
+  // resize shorter side, then guarantee the crop fits
+  std::vector<uint8_t> tmp;
+  if (ap.resize_short > 0 && std::min(h, w) != ap.resize_short) {
+    int nh, nw;
+    if (h < w) {
+      nh = ap.resize_short;
+      nw = std::max(1, int(int64_t(w) * ap.resize_short / h));
+    } else {
+      nw = ap.resize_short;
+      nh = std::max(1, int(int64_t(h) * ap.resize_short / w));
+    }
+    tmp.resize(uint64_t(nh) * nw * 3);
+    resize_bilinear(img.data(), h, w, tmp.data(), nh, nw);
+    img.swap(tmp);
+    h = nh;
+    w = nw;
+  }
+  if (h < ap.out_h || w < ap.out_w) {
+    float s = std::max(float(ap.out_h) / h, float(ap.out_w) / w);
+    int nh = std::max(ap.out_h, int(h * s + 0.5f));
+    int nw = std::max(ap.out_w, int(w * s + 0.5f));
+    tmp.resize(uint64_t(nh) * nw * 3);
+    resize_bilinear(img.data(), h, w, tmp.data(), nh, nw);
+    img.swap(tmp);
+    h = nh;
+    w = nw;
+  }
+  int y0, x0;
+  if (ap.rand_crop) {
+    y0 = (h == ap.out_h) ? 0 : int((*rng)() % uint32_t(h - ap.out_h + 1));
+    x0 = (w == ap.out_w) ? 0 : int((*rng)() % uint32_t(w - ap.out_w + 1));
+  } else {
+    y0 = (h - ap.out_h) / 2;
+    x0 = (w - ap.out_w) / 2;
+  }
+  bool mirror = ap.rand_mirror && ((*rng)() & 1u);
+  const uint64_t plane = uint64_t(ap.out_h) * ap.out_w;
+  for (int y = 0; y < ap.out_h; ++y) {
+    const uint8_t* row = img.data() + (uint64_t(y0 + y) * w + x0) * 3;
+    for (int x = 0; x < ap.out_w; ++x) {
+      int sx = mirror ? (ap.out_w - 1 - x) : x;
+      const uint8_t* p = row + uint64_t(sx) * 3;
+      uint64_t o = uint64_t(y) * ap.out_w + x;
+      out[o] = (p[0] - ap.mean[0]) / ap.std[0];
+      out[plane + o] = (p[1] - ap.mean[1]) / ap.std[1];
+      out[2 * plane + o] = (p[2] - ap.mean[2]) / ap.std[2];
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Prefetching batch pipeline
+// ---------------------------------------------------------------------------
+
+struct Batch {
+  std::vector<float> data;    // batch * 3 * H * W
+  std::vector<float> labels;  // batch * label_width
+  int pad = 0;                // trailing wrapped records (last batch)
+  int errors = 0;             // undecodable records (zero-filled)
+};
+
+struct Pipeline {
+  RecFile* file = nullptr;
+  std::vector<uint64_t> offsets;   // record byte offsets (from .idx)
+  std::vector<uint32_t> order;     // shuffled view of [0, n)
+  AugParams aug;
+  int batch = 0, label_width = 1, nthreads = 1, depth = 2;
+  int shuffle = 0;
+  uint64_t seed = 0;
+  int epoch = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  int n_batches = 0;
+  int next_produce = 0;              // guarded by mu
+  int next_deliver = 0;              // guarded by mu
+  std::map<int, Batch*> completed;   // guarded by mu
+  std::deque<Batch*> free_slots;     // guarded by mu
+  int in_flight = 0;                 // claimed but not completed, guarded by mu
+  bool paused = false;               // epoch transition in progress
+  bool stopping = false;
+  std::vector<std::thread> workers;
+  std::vector<Batch> slots;
+
+  ~Pipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_work.notify_all();
+    cv_done.notify_all();
+    for (auto& t : workers) t.join();
+    rec_close(file);
+  }
+};
+
+void worker_loop(Pipeline* p) {
+  const uint64_t per_img = uint64_t(3) * p->aug.out_h * p->aug.out_w;
+  for (;;) {
+    int bidx = -1;
+    Batch* slot = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      p->cv_work.wait(lk, [&] {
+        return p->stopping ||
+               (!p->paused && p->next_produce < p->n_batches &&
+                !p->free_slots.empty());
+      });
+      if (p->stopping) return;
+      bidx = p->next_produce++;
+      p->in_flight++;
+      slot = p->free_slots.front();
+      p->free_slots.pop_front();
+    }
+    // deterministic per-record RNG: (seed, epoch, record position)
+    slot->pad = 0;
+    slot->errors = 0;
+    int n = int(p->order.size());
+    for (int i = 0; i < p->batch; ++i) {
+      int64_t pos = int64_t(bidx) * p->batch + i;
+      if (pos >= n) {
+        pos %= n;  // wrap: reference round_batch padding
+        slot->pad++;
+      }
+      uint32_t rec = p->order[pos];
+      std::mt19937 rng(uint32_t(p->seed * 1315423911u + p->epoch * 2654435761u +
+                                uint32_t(bidx * p->batch + i)));
+      const uint8_t* data;
+      uint64_t len;
+      IRView ir;
+      bool ok = rec_at(p->file, p->offsets[rec], &data, &len) &&
+                ir_parse(data, len, &ir);
+      float* out = slot->data.data() + uint64_t(i) * per_img;
+      float* lab = slot->labels.data() + uint64_t(i) * p->label_width;
+      if (!ok) {
+        std::fill(out, out + per_img, 0.f);
+        std::fill(lab, lab + p->label_width, 0.f);
+        slot->errors++;
+        continue;
+      }
+      for (int l = 0; l < p->label_width; ++l)
+        lab[l] = ir.labels ? (l < int(ir.flag) ? ir.labels[l] : 0.f)
+                           : (l == 0 ? ir.label : 0.f);
+      bool dec_ok;
+      process_record(ir.img, ir.img_len, p->aug, out, &rng, &dec_ok);
+      if (!dec_ok) slot->errors++;
+    }
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->completed[bidx] = slot;
+      p->in_flight--;
+    }
+    p->cv_done.notify_all();
+  }
+}
+
+// Requires p->mu held.
+void start_epoch_locked(Pipeline* p) {
+  p->epoch++;
+  if (p->shuffle) {
+    std::mt19937_64 rng(p->seed + p->epoch);
+    std::shuffle(p->order.begin(), p->order.end(), rng);
+  }
+  p->next_produce = 0;
+  p->next_deliver = 0;
+  for (auto& kv : p->completed) p->free_slots.push_back(kv.second);
+  p->completed.clear();
+  p->paused = false;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* mxtpu_rec_open(const char* path) { return rec_open(path); }
+
+void mxtpu_rec_close(void* h) { rec_close(static_cast<RecFile*>(h)); }
+
+// Zero-copy record view; returns 1 on success.
+int mxtpu_rec_at(void* h, uint64_t offset, const uint8_t** data,
+                 uint64_t* len) {
+  return rec_at(static_cast<RecFile*>(h), offset, data, len) ? 1 : 0;
+}
+
+// Scan the whole file, writing record offsets into `offsets` (capacity
+// `cap`); returns the number of records found (may exceed cap — call again
+// with a larger buffer), or -1 on framing error.
+int64_t mxtpu_rec_scan(void* h, uint64_t* offsets, int64_t cap) {
+  auto* f = static_cast<RecFile*>(h);
+  uint64_t off = 0;
+  int64_t n = 0;
+  while (off + 8 <= f->size) {
+    uint32_t magic, lrec;
+    std::memcpy(&magic, f->base + off, 4);
+    std::memcpy(&lrec, f->base + off + 4, 4);
+    if (magic != kMagic) return -1;
+    uint64_t len = lrec & ((1u << 29) - 1);
+    if (n < cap) offsets[n] = off;
+    n++;
+    off += 8 + ((len + 3) / 4) * 4;
+  }
+  return n;
+}
+
+// Decode one JPEG into caller-provided RGB u8 buffer (for parity tests and
+// the Python imdecode fast path).  Returns 1 and sets h/w on success; if
+// the buffer (capacity `cap` bytes) is too small, returns -(needed bytes).
+int64_t mxtpu_jpeg_decode(const uint8_t* buf, uint64_t len, uint8_t* out,
+                          int64_t cap, int* h, int* w) {
+  std::vector<uint8_t> img;
+  if (!jpeg_decode(buf, len, &img, h, w)) return 0;
+  if (int64_t(img.size()) > cap) return -int64_t(img.size());
+  std::memcpy(out, img.data(), img.size());
+  return 1;
+}
+
+void* mxtpu_pipeline_create(const char* rec_path, const uint64_t* offsets,
+                            int64_t n, int batch, int out_h, int out_w,
+                            int label_width, int resize_short, int rand_crop,
+                            int rand_mirror, const float* mean,
+                            const float* stdv, int shuffle, uint64_t seed,
+                            int nthreads, int depth) {
+  if (n <= 0 || batch <= 0) return nullptr;
+  RecFile* f = rec_open(rec_path);
+  if (!f) return nullptr;
+  auto* p = new Pipeline();
+  p->file = f;
+  p->offsets.assign(offsets, offsets + n);
+  p->order.resize(n);
+  for (int64_t i = 0; i < n; ++i) p->order[i] = uint32_t(i);
+  p->aug.out_h = out_h;
+  p->aug.out_w = out_w;
+  p->aug.resize_short = resize_short;
+  p->aug.rand_crop = rand_crop;
+  p->aug.rand_mirror = rand_mirror;
+  for (int c = 0; c < 3; ++c) {
+    p->aug.mean[c] = mean ? mean[c] : 0.f;
+    p->aug.std[c] = stdv && stdv[c] > 0 ? stdv[c] : 1.f;
+  }
+  p->batch = batch;
+  p->label_width = std::max(1, label_width);
+  p->shuffle = shuffle;
+  p->seed = seed;
+  p->nthreads = std::max(1, nthreads);
+  p->depth = std::max(2, depth);
+  p->n_batches = int((n + batch - 1) / batch);
+  p->slots.resize(p->depth);
+  for (auto& s : p->slots) {
+    s.data.resize(uint64_t(batch) * 3 * out_h * out_w);
+    s.labels.resize(uint64_t(batch) * p->label_width);
+    p->free_slots.push_back(&s);
+  }
+  // fully initialize epoch state BEFORE spawning workers — a worker's wait
+  // predicate is satisfiable the moment it starts
+  p->epoch = -1;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    start_epoch_locked(p);
+  }
+  for (int i = 0; i < p->nthreads; ++i)
+    p->workers.emplace_back(worker_loop, p);
+  p->cv_work.notify_all();
+  return p;
+}
+
+// Blocks for the next in-order batch; copies into `data`/`labels`.
+// Returns: >=0 pad count, -1 epoch exhausted (call reset), -2 error.
+int mxtpu_pipeline_next(void* h, float* data, float* labels, int* errors) {
+  auto* p = static_cast<Pipeline*>(h);
+  Batch* b = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->next_deliver >= p->n_batches) return -1;
+    int want = p->next_deliver;
+    p->cv_done.wait(lk, [&] {
+      return p->stopping || p->completed.count(want);
+    });
+    if (p->stopping) return -2;
+    b = p->completed[want];
+    p->completed.erase(want);
+    p->next_deliver++;
+  }
+  std::memcpy(data, b->data.data(), b->data.size() * sizeof(float));
+  std::memcpy(labels, b->labels.data(), b->labels.size() * sizeof(float));
+  int pad = b->pad;
+  if (errors) *errors = b->errors;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->free_slots.push_back(b);
+  }
+  p->cv_work.notify_all();
+  return pad;
+}
+
+void mxtpu_pipeline_reset(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  // Pause production, drain in-flight work, then restart — all under one
+  // mutex hold, so no worker can claim a batch between drain and restart.
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->paused = true;
+  p->cv_done.wait(lk, [&] { return p->stopping || p->in_flight == 0; });
+  if (p->stopping) return;
+  start_epoch_locked(p);
+  lk.unlock();
+  p->cv_work.notify_all();
+}
+
+int mxtpu_pipeline_nbatches(void* h) {
+  return static_cast<Pipeline*>(h)->n_batches;
+}
+
+void mxtpu_pipeline_destroy(void* h) { delete static_cast<Pipeline*>(h); }
+
+}  // extern "C"
