@@ -1,5 +1,6 @@
 """``mx.contrib``: experimental / auxiliary subsystems (reference
 ``python/mxnet/contrib/``)."""
 from . import amp  # noqa: F401
+from . import quantization  # noqa: F401
 
-__all__ = ["amp"]
+__all__ = ["amp", "quantization"]

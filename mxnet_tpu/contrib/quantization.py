@@ -1,0 +1,400 @@
+"""INT8 post-training quantization driver.
+
+Parity target: ``python/mxnet/contrib/quantization.py`` (``quantize_model``
+:423, calib modes none/naive/entropy :457-464) + the C++ graph rewrite
+``src/operator/quantization/quantize_graph_pass.cc``.
+
+Flow (same as the reference):
+
+1. **Rewrite** the float Symbol: Convolution/FullyConnected become
+   ``_contrib_quantized_conv``/``_fully_connected`` (int8 in, int32 out)
+   with ``_contrib_quantize_v2`` inserted on float input edges,
+   ``_contrib_requantize`` folding the int32 accumulator back to int8, and
+   ``_contrib_dequantize`` where a float consumer needs the value.
+   Pooling/Flatten/ReLU/elemwise_add pass through in the int8 domain.
+2. **Quantize parameters offline** — weights/biases become int8 arrays in
+   ``qarg_params`` (``<name>_quantize`` + ``_min``/``_max``), the analogue
+   of the reference's offline ``_quantize_params``.
+3. **Calibrate** (naive min/max or entropy/KL thresholds, reference
+   ``_LayerHistogramCollector``/``_get_optimal_threshold``) by running the
+   float graph over ``calib_data`` and folding the resulting ranges into
+   the quantize/requantize nodes as static attrs — so the whole int8 graph
+   jit-compiles with no runtime range reductions.
+
+TPU note: int8 matmuls/convs accumulate in int32 on the MXU via
+``preferred_element_type`` — XLA's int8 path plays the role of the
+reference's cuDNN/MKLDNN int8 kernels.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "quantize_graph"]
+
+_QUANTIZED_MAIN = {"Convolution", "FullyConnected"}
+_PASS_THROUGH = {"Flatten", "flatten", "Pooling", "elemwise_add", "_plus",
+                 "Activation"}
+
+
+def _absmax_to_range(absmax):
+    a = float(absmax)
+    return (-a, a)
+
+
+def _smooth_distribution(p, eps=1e-4):
+    """Move eps mass onto zero bins, taken proportionally from nonzero bins
+    (reference _smooth_distribution)."""
+    is_zero = p == 0
+    n_zeros = int(is_zero.sum())
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0 or n_zeros == 0:
+        return p
+    eps1 = eps * n_zeros / n_nonzeros
+    out = p.astype(onp.float64).copy()
+    out[is_zero] = eps
+    out[~is_zero] -= eps1
+    if (out < 0).any():
+        return None
+    return out
+
+
+def _kl(p, q):
+    p = p / p.sum()
+    q = q / q.sum()
+    mask = p > 0
+    return float(onp.sum(p[mask] * onp.log(p[mask] / q[mask])))
+
+
+def _optimal_threshold(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| from a symmetric histogram —
+    the TensorRT-style calibration of the reference's
+    ``_get_optimal_threshold``: candidate windows are truncated at
+    [zero-i, zero+i]; p is the window WITH outlier mass folded into its
+    edge bins, q is the 255-level quantization of the window WITHOUT the
+    outliers — so clipping real mass shows up as divergence at the edges."""
+    hist = hist.astype(onp.float64)
+    num_bins = hist.size
+    zero_bin = num_bins // 2
+    best_kl = onp.inf
+    best_t = float(hist_edges[-1])
+    step = max(1, (zero_bin - num_quantized_bins // 2) // 128)
+    for i in range(num_quantized_bins // 2, zero_bin + 1, step):
+        lo, hi = zero_bin - i, zero_bin + i + 1
+        window = hist[lo:hi]
+        if window.sum() == 0:
+            continue
+        p = window.copy()
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        nonzero = p != 0
+        # q: merge the (outlier-free) window into 255 equal-width buckets,
+        # spreading each bucket's mass over its nonzero positions
+        n_merged = window.size // num_quantized_bins
+        q = onp.zeros_like(window)
+        for j in range(num_quantized_bins):
+            s = j * n_merged
+            e = window.size if j == num_quantized_bins - 1 else s + n_merged
+            mass = window[s:e].sum()
+            nz = nonzero[s:e]
+            if nz.sum():
+                q[s:e][nz] = mass / nz.sum()
+        q[p == 0] = 0
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        if ps is None or qs is None or qs.sum() == 0:
+            continue
+        kl = _kl(ps, qs)
+        if kl < best_kl:
+            best_kl = kl
+            best_t = float(hist_edges[hi]) if hi < len(hist_edges) \
+                else float(hist_edges[-1])
+    return best_t
+
+
+class _Calibrator:
+    """Collects per-tensor ranges over calibration batches."""
+
+    def __init__(self, mode, num_bins=8001):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.absmax = {}
+        self.hists = {}
+
+    def update_absmax(self, name, arr):
+        a = float(onp.max(onp.abs(arr))) if arr.size else 0.0
+        self.absmax[name] = max(self.absmax.get(name, 0.0), a)
+
+    def update_hist(self, name, arr):
+        a = self.absmax.get(name, 0.0) or 1e-8
+        h, edges = onp.histogram(arr, bins=self.num_bins, range=(-a, a))
+        if name in self.hists:
+            self.hists[name] = (self.hists[name][0] + h, edges)
+        else:
+            self.hists[name] = (h, edges)
+
+    def ranges(self):
+        out = {}
+        for name, a in self.absmax.items():
+            if self.mode == "entropy" and name in self.hists:
+                h, edges = self.hists[name]
+                t = _optimal_threshold(h, edges)
+                out[name] = (-t, t)
+            else:
+                out[name] = _absmax_to_range(a)
+        return out
+
+
+def quantize_graph(sym, arg_params, excluded_sym_names=(), calib_ranges=None,
+                   quantized_dtype="int8"):
+    """Rewrite a float Symbol into its int8 form; returns
+    (qsym, qarg_params, calib_tensor_names).
+
+    ``calib_ranges`` maps original node names → (min, max) float ranges;
+    when absent for a node the quantize/requantize ops fall back to runtime
+    min/max (= calib_mode='none')."""
+    from .. import ndarray as nd
+    from ..symbol import Symbol, var
+    from ..symbol import _invoke_op
+    from ..symbol.symbol import _SymNode
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported (the "
+                         "reference's uint8 path needs asymmetric kernels)")
+    excluded = set(excluded_sym_names or ())
+    calib_ranges = calib_ranges or {}
+    qarg_params = dict(arg_params)
+    calib_names = []
+
+    out_entries = list(sym._entries)
+    nodes = sym._topo()
+
+    # float_syms: (id(node), out_idx) -> Symbol producing the float value
+    # q_syms:     (id(node), out_idx) -> (data, mn, mx) Symbols, int8 domain
+    float_syms = {}
+    q_syms = {}
+
+    def node_sym(node, idx):
+        return Symbol([(node, idx)])
+
+    def as_float(node, idx):
+        key = (id(node), idx)
+        if key in float_syms:
+            return float_syms[key]
+        if key in q_syms:
+            d, mn, mx = q_syms[key]
+            f = _invoke_op("_contrib_dequantize", [d, mn, mx], {})
+            float_syms[key] = f
+            return f
+        raise MXNetError("internal: value not computed")
+
+    def as_quant(node, idx, src_name):
+        """int8 triple for an edge, inserting quantize_v2 if needed."""
+        key = (id(node), idx)
+        if key in q_syms:
+            return q_syms[key]
+        f = as_float(node, idx)
+        attrs = {"out_type": "int8"}
+        if src_name in calib_ranges:
+            mn, mx = calib_ranges[src_name]
+            attrs["min_calib_range"] = float(mn)
+            attrs["max_calib_range"] = float(mx)
+        calib_names.append(src_name)
+        trip = _invoke_op("_contrib_quantize_v2", [f], attrs)
+        trip = (trip[0], trip[1], trip[2])
+        q_syms[key] = trip
+        return trip
+
+    def quant_param(name):
+        """Offline-quantize a parameter; returns (var, var_min, var_max)."""
+        qn = name + "_quantize"
+        if qn not in qarg_params:
+            w = arg_params[name]
+            wnp = w.asnumpy() if hasattr(w, "asnumpy") else onp.asarray(w)
+            amax = float(onp.max(onp.abs(wnp))) or 1.0
+            scale = 127.0 / amax
+            q = onp.clip(onp.rint(wnp * scale), -127, 127).astype(onp.int8)
+            qarg_params[qn] = nd.array(q)
+            qarg_params[qn + "_min"] = nd.array(
+                onp.asarray(-amax, onp.float32))
+            qarg_params[qn + "_max"] = nd.array(
+                onp.asarray(amax, onp.float32))
+            qarg_params.pop(name, None)
+        return (var(qn), var(qn + "_min"), var(qn + "_max"))
+
+    def is_param_var(node):
+        return node.op is None and node.name in arg_params
+
+    for node in nodes:
+        if node.op is None:
+            float_syms[(id(node), 0)] = node_sym(node, 0)
+            continue
+        in_names = node.in_names or [None] * len(node.inputs)
+        quantize_this = (node.op in _QUANTIZED_MAIN
+                         and node.name not in excluded)
+        if quantize_this:
+            # --- quantized Convolution / FullyConnected ---
+            slots = dict(zip(in_names, node.inputs))
+            data_n, data_i = slots["data"]
+            dq, dmn, dmx = as_quant(data_n, data_i, data_n.name)
+            wnode, _ = slots["weight"]
+            if not is_param_var(wnode):
+                raise MXNetError(
+                    "quantization requires %s weight to be a parameter"
+                    % node.name)
+            wq, wmn, wmx = quant_param(wnode.name)
+            no_bias = bool(node.attrs.get("no_bias", False))
+            if not no_bias and "bias" in slots and \
+                    is_param_var(slots["bias"][0]):
+                bq, bmn, bmx = quant_param(slots["bias"][0].name)
+            else:
+                no_bias = True
+                bq, bmn, bmx = wq, wmn, wmx  # unused
+            attrs = {k: v for k, v in node.attrs.items()
+                     if k not in ("cudnn_tune", "cudnn_off", "workspace")}
+            attrs["no_bias"] = no_bias
+            qop = ("_contrib_quantized_conv" if node.op == "Convolution"
+                   else "_contrib_quantized_fully_connected")
+            acc = _invoke_op(
+                qop, [dq, wq, bq, dmn, dmx, wmn, wmx, bmn, bmx], attrs,
+                name=node.name + "_quantize")
+            racc = {"min_calib_range": None, "max_calib_range": None}
+            if node.name in calib_ranges:
+                mn, mx = calib_ranges[node.name]
+                racc = {"min_calib_range": float(mn),
+                        "max_calib_range": float(mx)}
+            calib_names.append(node.name)
+            req = _invoke_op("_contrib_requantize",
+                             [acc[0], acc[1], acc[2]],
+                             {k: v for k, v in racc.items()
+                              if v is not None},
+                             name=node.name + "_requantize")
+            q_syms[(id(node), 0)] = (req[0], req[1], req[2])
+            continue
+        pass_q = (node.op in _PASS_THROUGH and node.name not in excluded
+                  and all((id(c), i) in q_syms for c, i in node.inputs)
+                  and (node.op != "Activation"
+                       or node.attrs.get("act_type") == "relu")
+                  and (node.op != "Pooling"
+                       or node.attrs.get("pool_type", "max")
+                       in ("max", "avg")))
+        if pass_q:
+            # --- int8-domain pass-through ---
+            if node.op in ("Flatten", "flatten"):
+                d, mn, mx = q_syms[(id(node.inputs[0][0]), node.inputs[0][1])]
+                out = _invoke_op("_contrib_quantized_flatten", [d, mn, mx],
+                                 {}, name=node.name + "_quantize")
+            elif node.op == "Pooling":
+                d, mn, mx = q_syms[(id(node.inputs[0][0]), node.inputs[0][1])]
+                out = _invoke_op("_contrib_quantized_pooling", [d, mn, mx],
+                                 dict(node.attrs),
+                                 name=node.name + "_quantize")
+            elif node.op == "Activation":
+                d, mn, mx = q_syms[(id(node.inputs[0][0]), node.inputs[0][1])]
+                out = _invoke_op("_contrib_quantized_act", [d, mn, mx],
+                                 {"act_type": "relu"},
+                                 name=node.name + "_quantize")
+            else:  # elemwise_add
+                (a, ai), (b, bi) = node.inputs[0], node.inputs[1]
+                da, mna, mxa = q_syms[(id(a), ai)]
+                db, mnb, mxb = q_syms[(id(b), bi)]
+                acc = _invoke_op("_contrib_quantized_elemwise_add",
+                                 [da, db, mna, mxa, mnb, mxb], {},
+                                 name=node.name + "_quantize")
+                attrs = {}
+                if node.name in calib_ranges:
+                    mn, mx = calib_ranges[node.name]
+                    attrs = {"min_calib_range": float(mn),
+                             "max_calib_range": float(mx)}
+                calib_names.append(node.name)
+                out = _invoke_op("_contrib_requantize",
+                                 [acc[0], acc[1], acc[2]], attrs,
+                                 name=node.name + "_requantize")
+            q_syms[(id(node), 0)] = (out[0], out[1], out[2])
+            continue
+        # --- float node: rebuild with float inputs ---
+        ins = [as_float(c, i) for c, i in node.inputs]
+        out = _invoke_op(node.op, ins, dict(node.attrs), name=node.name,
+                         in_names=node.in_names)
+        for i in range(out._entries[0][0].num_outputs):
+            float_syms[(id(node), i)] = out[i] \
+                if out._entries[0][0].num_outputs > 1 else out
+
+    outs = [as_float(n, i) for n, i in out_entries]
+    from ..symbol import Group
+    qsym = Group(outs) if len(outs) > 1 else outs[0]
+    return qsym, qarg_params, sorted(set(calib_names))
+
+
+def _collect_calibration(sym, arg_params, aux_params, calib_names,
+                         calib_data, mode, num_calib_examples=None,
+                         data_names=("data",), label_names=("softmax_label",)):
+    """Run the float graph over calib_data, recording ranges for every
+    tensor in calib_names (reference _collect_layer_statistics)."""
+    from ..symbol import Group, Symbol
+
+    name_to_entry = {}
+    for node in sym._topo():
+        for i in range(getattr(node, "num_outputs", 1)):
+            nm = node.name if i == 0 else "%s_out%d" % (node.name, i)
+            name_to_entry.setdefault(nm, (node, i))
+        name_to_entry.setdefault(node.name, (node, 0))
+    targets = [n for n in calib_names if n in name_to_entry]
+    group = Group([Symbol([name_to_entry[n]]) for n in targets])
+
+    cal = _Calibrator(mode)
+    passes = 2 if mode == "entropy" else 1
+    for p in range(passes):
+        calib_data.reset()
+        seen = 0
+        for batch in calib_data:
+            feed = dict(arg_params)
+            feed.update(aux_params or {})
+            for dn, arr in zip(data_names, batch.data):
+                feed[dn] = arr
+            for ln, arr in zip(label_names, batch.label or []):
+                feed[ln] = arr
+            outs = group.eval_imperative(feed)
+            outs = outs if isinstance(outs, list) else [outs]
+            for nme, o in zip(targets, outs):
+                a = o.asnumpy()
+                if p == 0:
+                    cal.update_absmax(nme, a)
+                else:
+                    cal.update_hist(nme, a)
+            seen += batch.data[0].shape[0]
+            if num_calib_examples is not None and seen >= num_calib_examples:
+                break
+    return cal.ranges()
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="entropy",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a float model (reference contrib/quantization.py:423).
+
+    Returns ``(qsym, qarg_params, aux_params)``; ``qsym`` evaluates the
+    int8 graph, ``qarg_params`` holds offline-quantized int8 weights."""
+    logger = logger or logging.getLogger(__name__)
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError("calib_mode must be none/naive/entropy")
+    ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_mode %r requires calib_data" % calib_mode)
+        # pass 1: discover which tensors the rewrite will quantize
+        _, _, calib_names = quantize_graph(
+            sym, arg_params, excluded_sym_names, {}, quantized_dtype)
+        ranges = _collect_calibration(
+            sym, arg_params, aux_params, calib_names, calib_data, calib_mode,
+            num_calib_examples, data_names, label_names)
+        logger.info("calibrated %d tensors (%s mode)", len(ranges),
+                    calib_mode)
+    qsym, qarg_params, _ = quantize_graph(
+        sym, arg_params, excluded_sym_names, ranges, quantized_dtype)
+    return qsym, qarg_params, dict(aux_params or {})

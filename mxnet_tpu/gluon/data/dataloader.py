@@ -2,15 +2,24 @@
 
 The reference uses multiprocessing workers + POSIX-shm NDArray pickling
 (``dataloader.py:66-120``, C++ ``cpu_shared_storage_manager.h``) because
-Python decode is the bottleneck for GPU input pipelines.  Here workers are a
-``ThreadPoolExecutor``: batchification is numpy (releases the GIL in C),
-device transfer is a single async ``jax.device_put`` per batch, and thread
-workers avoid the fork-safety problems the reference needed
-``pthread_atfork`` engine restarts for (``src/initialize.cc:49-58``).  The
-``num_workers`` / ``pin_memory`` API is kept for parity.
+Python decode is the bottleneck for accelerator input pipelines.  Same
+design here, adapted to the JAX runtime:
+
+* ``num_workers > 0`` → a pool of **spawned** worker processes.  Spawn, not
+  fork: XLA's CPU client owns thread pools that do not survive ``fork()``
+  (the reference needed ``pthread_atfork`` engine restarts for the same
+  class of problem, ``src/initialize.cc:49-58``).  Workers are pinned to
+  the CPU backend (``JAX_PLATFORMS=cpu``) so they never touch the TPU the
+  parent holds.
+* Batches come back through ``multiprocessing.shared_memory`` segments —
+  the analogue of the reference's ``CPUSharedStorageManager`` — so only
+  (name, shape, dtype) metadata crosses the result pipe.
+* ``thread_pool=True`` keeps the ThreadPoolExecutor path (numpy
+  batchification releases the GIL, fine for light transforms).
 """
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as onp
@@ -30,6 +39,88 @@ def default_batchify_fn(data):
         return [default_batchify_fn(i) for i in data]
     data = onp.asarray(data)
     return array(data)
+
+
+# ---------------------------------------------------------------------------
+# worker-process machinery (module-level: must be picklable under spawn)
+# ---------------------------------------------------------------------------
+
+_WORKER_DATASET = None
+_WORKER_BATCHIFY = None
+
+
+def _to_numpy_tree(obj):
+    """NDArray/array-tree → numpy-tree (workers ship numpy via shm only)."""
+    if isinstance(obj, NDArray):
+        return obj.asnumpy()
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(o) for o in obj)
+    return onp.asarray(obj)
+
+
+def _numpy_batchify(data):
+    """default_batchify_fn without creating device arrays."""
+    if isinstance(data[0], (list, tuple)):
+        return [_numpy_batchify(list(x)) for x in zip(*data)]
+    return onp.stack([onp.asarray(d) for d in data])
+
+
+def _worker_initializer(dataset, batchify_fn):
+    global _WORKER_DATASET, _WORKER_BATCHIFY
+    _WORKER_DATASET = dataset
+    _WORKER_BATCHIFY = batchify_fn
+
+
+def _shm_export(arr):
+    """Copy one numpy array into a fresh shm segment; return metadata."""
+    from multiprocessing import shared_memory
+    arr = onp.ascontiguousarray(arr)
+    shm = shared_memory.SharedMemory(create=True, size=max(1, arr.nbytes))
+    onp.ndarray(arr.shape, arr.dtype, buffer=shm.buf)[...] = arr
+    meta = ("shm", shm.name, arr.shape, str(arr.dtype))
+    # the parent unlinks; stop this process's resource tracker from
+    # double-freeing (standard SharedMemory producer/consumer handoff)
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.close()
+    return meta
+
+
+def _shm_export_tree(obj):
+    if isinstance(obj, onp.ndarray):
+        return _shm_export(obj)
+    if isinstance(obj, (list, tuple)):
+        return ("tree", [_shm_export_tree(o) for o in obj])
+    return ("obj", obj)
+
+
+def _shm_import_tree(meta, wrap):
+    kind = meta[0]
+    if kind == "shm":
+        from multiprocessing import shared_memory
+        _, name, shape, dtype = meta
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            arr = onp.ndarray(shape, dtype, buffer=shm.buf).copy()
+        finally:
+            shm.close()
+            shm.unlink()
+        return wrap(arr)
+    if kind == "tree":
+        return [_shm_import_tree(m, wrap) for m in meta[1]]
+    return meta[1]
+
+
+def _worker_fn(indices):
+    samples = [_WORKER_DATASET[i] for i in indices]
+    if _WORKER_BATCHIFY is not None:
+        batch = _to_numpy_tree(_WORKER_BATCHIFY(samples))
+    else:
+        batch = _numpy_batchify([_to_numpy_tree(s) for s in samples])
+    return _shm_export_tree(batch)
 
 
 class DataLoader:
@@ -65,23 +156,56 @@ class DataLoader:
         self._num_workers = num_workers if num_workers >= 0 else 0
         self._prefetch = max(0, int(prefetch) if prefetch is not None
                              else 2 * self._num_workers)
-        if batchify_fn is None:
-            self._batchify_fn = default_batchify_fn
-        else:
-            self._batchify_fn = batchify_fn
+        self._batchify_fn = batchify_fn
+        self._thread_pool = thread_pool
         self._executor = None
+        self._pool = None
         if self._num_workers > 0:
-            self._executor = ThreadPoolExecutor(max_workers=self._num_workers)
+            if not thread_pool:
+                import pickle
+                try:  # spawn workers need picklable dataset + batchify_fn
+                    pickle.dumps((self._dataset, self._batchify_fn))
+                except Exception:
+                    import warnings
+                    warnings.warn(
+                        "DataLoader: dataset or batchify_fn is not "
+                        "picklable; falling back to thread workers",
+                        stacklevel=2)
+                    thread_pool = True
+            if thread_pool:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self._num_workers)
+            else:
+                self._pool = self._create_pool()
+
+    def _create_pool(self):
+        import multiprocessing as mp
+        method = os.environ.get("MXNET_MP_START_METHOD", "spawn")
+        ctx = mp.get_context(method)
+        # children must never claim the accelerator the parent holds
+        old = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            return ctx.Pool(self._num_workers, initializer=_worker_initializer,
+                            initargs=(self._dataset, self._batchify_fn))
+        finally:
+            if old is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = old
 
     def _make_batch(self, indices):
-        return self._batchify_fn([self._dataset[i] for i in indices])
+        fn = self._batchify_fn or default_batchify_fn
+        return fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        if self._pool is not None:
+            yield from self._iter_mp()
+            return
         if self._executor is None:
             for batch_indices in self._batch_sampler:
                 yield self._make_batch(batch_indices)
             return
-
         # pipelined: keep `prefetch` batches in flight
         batches = iter(self._batch_sampler)
         futures = []
@@ -100,5 +224,38 @@ class DataLoader:
                 pass
             yield f.result()
 
+    def _iter_mp(self):
+        batches = iter(self._batch_sampler)
+        inflight = []
+        try:
+            for _ in range(self._prefetch + 1):
+                inflight.append(
+                    self._pool.apply_async(_worker_fn, (next(batches),)))
+        except StopIteration:
+            pass
+        while inflight:
+            res = inflight.pop(0)
+            try:
+                inflight.append(
+                    self._pool.apply_async(_worker_fn, (next(batches),)))
+            except StopIteration:
+                pass
+            yield _shm_import_tree(res.get(), array)
+
     def __len__(self):
         return len(self._batch_sampler)
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -19,8 +19,9 @@ module therefore provides:
   the same API, but ``push`` all-reduces over the mesh's data-parallel axis
   (``mxnet_tpu.parallel``).  rank/num_workers map to
   ``jax.process_index/process_count``.
-* gradient-compression API accepted for parity (2-bit compression is not
-  needed on ICI; stored and surfaced via ``gradient_compression`` attr).
+* 2-bit error-feedback gradient compression (``gradient_compression.py``),
+  applied to pushed gradients before the cross-worker reduction exactly like
+  the reference's dist push path.
 
 ``dist_async`` has no SPMD analogue and raises (SURVEY.md §7 hard-parts).
 """
@@ -92,17 +93,23 @@ class KVStore:
 
     # -- configuration -------------------------------------------------
     def set_gradient_compression(self, compression_params):
-        """Accepted for API parity (reference kvstore.py:394).  ICI
-        collectives are not bandwidth-bound at MXNet's model scale, so
-        compression is recorded but not applied; a warning makes the
-        descope visible instead of silent."""
-        import warnings
-        warnings.warn(
-            "gradient compression is a no-op on the TPU build: ICI "
-            "all-reduce is not bandwidth-bound at these model sizes; "
-            "parameters are accepted for API compatibility only.",
-            stacklevel=2)
-        self._compression_params = compression_params
+        """Enable 2-bit error-feedback gradient compression on pushed
+        gradients (reference kvstore.py:394 / gradient_compression.h:38).
+        Gradients are quantized to {-t, 0, +t} before the cross-worker
+        reduction; the quantization error feeds back into the next push."""
+        from .gradient_compression import GradientCompression
+        self._gc = GradientCompression(compression_params)
+        self._compression_params = self._gc.get_params()
+
+    def _compress_grad(self, key, value):
+        """Apply configured compression to one pushed gradient NDArray."""
+        gc = getattr(self, "_gc", None)
+        if gc is None:
+            return value
+        if isinstance(value, NDArray):
+            from .ndarray.ndarray import _wrap
+            return _wrap(gc.compress(key, value._data))
+        return gc.compress(key, value)
 
     def set_optimizer(self, optimizer):
         """Install an optimizer as the updater (reference kvstore.py:450 —
@@ -162,6 +169,12 @@ class KVStoreLocal(KVStore):
         for k, v in zip(keys, values):
             self._store[k] = v.copy() if isinstance(v, NDArray) else v
 
+    def _transform_grad(self, key, value):
+        """Hook applied to each merged gradient before it reaches the
+        updater/store: compression here; subclasses add the cross-worker
+        reduction."""
+        return self._compress_grad(key, value)
+
     def push(self, key, value, priority=0):
         keys = _as_list(key)
         values = _as_list(value)
@@ -177,6 +190,7 @@ class KVStoreLocal(KVStore):
                 v = merged
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % str(k))
+            v = self._transform_grad(k, v)
             if self._updater is not None:
                 idx = int(k) if isinstance(k, str) and k.isdigit() else k
                 self._updater(idx, v, self._store[k])
@@ -210,19 +224,11 @@ class KVStoreTPU(KVStoreLocal):
     def __init__(self, type_str="tpu"):
         super().__init__(type_str)
 
-    def push(self, key, value, priority=0):
+    def _transform_grad(self, key, value):
+        # compress (worker-side, reference kvstore_dist.h:361), then
+        # all-reduce across the mesh (the server-side dequantized merge)
         from . import parallel
-        keys = _as_list(key)
-        values = _as_list(value)
-        reduced = []
-        for v in values:
-            if isinstance(v, (list, tuple)):
-                merged = v[0]
-                for o in v[1:]:
-                    merged = merged + o
-                v = merged
-            reduced.append(parallel.allreduce(v))
-        super().push(keys, reduced, priority)
+        return parallel.allreduce(self._compress_grad(key, value))
 
     @property
     def rank(self) -> int:

@@ -14,5 +14,7 @@ from . import rnn           # noqa: F401
 from . import control_flow  # noqa: F401
 from . import vision        # noqa: F401
 from . import contrib_ops   # noqa: F401
+from . import quantization  # noqa: F401
+from . import pallas_attention  # noqa: F401
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]

@@ -1,0 +1,224 @@
+"""INT8 quantization operators.
+
+Reference: ``src/operator/quantization/`` (~5.3k LoC): quantize/dequantize/
+requantize (+v2), quantized conv/fc/pooling/flatten/elemwise_add, driven by
+the graph rewrite in ``quantize_graph_pass.cc`` and the Python driver
+``python/mxnet/contrib/quantization.py``.
+
+TPU-native design: int8 matmul/conv run on the MXU via
+``lax.dot_general``/``lax.conv_general_dilated`` with
+``preferred_element_type=int32`` — the role the reference's cuDNN/MKLDNN
+int8 kernels play.  Quantized tensors travel as (int8 data, min_range,
+max_range) triples exactly like the reference's 3-output quantized ops.
+
+Quantization scheme (matches the reference's int8 path): symmetric,
+``scale = 127 / max(|min|, |max|)``, zero-point 0; uint8 uses the affine
+[0, 255] range only for quantize/dequantize parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .nn import _conv_dn, _tup
+
+_INT8_MAX = 127.0
+_UINT8_MAX = 255.0
+
+
+def _symmetric_scale(min_range, max_range):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return jnp.where(amax > 0, _INT8_MAX / amax, 1.0)
+
+
+@register("_contrib_quantize", aliases=("quantize",), num_outputs=3,
+          differentiable=False)
+def quantize(data, min_range, max_range, out_type: str = "uint8"):
+    """Float → int8/uint8 with the given ranges (reference quantize-inl.h).
+    Returns (quantized, min_range, max_range)."""
+    if out_type == "int8":
+        scale = _symmetric_scale(min_range, max_range)
+        q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+        amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        return q, -amax, amax
+    scale = jnp.where(max_range > min_range,
+                      _UINT8_MAX / (max_range - min_range), 1.0)
+    q = jnp.clip(jnp.rint((data - min_range) * scale), 0, 255).astype(
+        jnp.uint8)
+    return q, min_range, max_range
+
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",), num_outputs=3,
+          differentiable=False)
+def quantize_v2(data, min_calib_range: float = None,
+                max_calib_range: float = None, out_type: str = "int8"):
+    """Quantize with calibrated or data-derived ranges (reference
+    quantize_v2-inl.h)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    return quantize(data, mn, mx, out_type=out_type)
+
+
+@register("_contrib_dequantize", aliases=("dequantize",),
+          differentiable=False)
+def dequantize(data, min_range, max_range, out_type: str = "float32"):
+    """Int8/uint8 → float (reference dequantize-inl.h)."""
+    if data.dtype == jnp.uint8:
+        scale = (max_range - min_range) / _UINT8_MAX
+        return data.astype(jnp.float32) * scale + min_range
+    # symmetric: int8 spans ±127, int32 accumulators span ±(2^31-1)
+    denom = _INT8_MAX if data.dtype == jnp.int8 else (2.0 ** 31 - 1)
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (amax / denom)
+
+
+@register("_contrib_requantize", aliases=("requantize",), num_outputs=3,
+          differentiable=False)
+def requantize(data, min_range, max_range, min_calib_range: float = None,
+               max_calib_range: float = None):
+    """Int32 accumulator → int8 (reference requantize-inl.h).  min/max_range
+    here describe the int32 data's float range per unit."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / (2.0 ** 31 - 1))
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    return quantize(real, mn, mx, out_type="int8")
+
+
+def _int32_range(min_a, max_a, min_b, max_b):
+    """Float value of one int32 accumulator unit for a product of two
+    symmetric-int8 tensors, expressed as the range the int32 data spans
+    (reference quantization_utils.h GetQuantizedToFloatScale)."""
+    amax = jnp.maximum(jnp.abs(min_a), jnp.abs(max_a))
+    bmax = jnp.maximum(jnp.abs(min_b), jnp.abs(max_b))
+    unit = (amax / _INT8_MAX) * (bmax / _INT8_MAX)
+    hi = unit * (2.0 ** 31 - 1)
+    return -hi, hi
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), num_outputs=3,
+          differentiable=False)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias,
+                              num_hidden: int = 0, no_bias: bool = False,
+                              flatten: bool = True):
+    """int8 x int8 → int32 matmul on the MXU (reference
+    quantized_fully_connected.cc).  Returns (int32 out, min, max)."""
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    acc = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    lo, hi = _int32_range(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        # bias arrives int8 with its own range; rescale into acc units
+        unit = hi / (2.0 ** 31 - 1)
+        bmax = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias))
+        bscale = jnp.where(unit > 0, (bmax / _INT8_MAX) / unit, 0.0)
+        acc = acc + jnp.rint(bias.astype(jnp.float32) * bscale).astype(
+            jnp.int32)
+    return acc, lo, hi
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",),
+          num_outputs=3, differentiable=False)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, kernel=(), stride=None,
+                   dilate=None, pad=None, num_filter: int = 0,
+                   num_group: int = 1, no_bias: bool = False, layout=None):
+    """int8 convolution with int32 accumulation (reference
+    quantized_conv.cc)."""
+    n = len(kernel) if kernel else data.ndim - 2
+    strides = _tup(stride, n)
+    dil = _tup(dilate, n)
+    pads = _tup(pad, n) if pad is not None else (0,) * n
+    acc = lax.conv_general_dilated(
+        data, weight, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dil,
+        dimension_numbers=_conv_dn(n), feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    lo, hi = _int32_range(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        unit = hi / (2.0 ** 31 - 1)
+        bmax = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias))
+        bscale = jnp.where(unit > 0, (bmax / _INT8_MAX) / unit, 0.0)
+        b32 = jnp.rint(bias.astype(jnp.float32) * bscale).astype(jnp.int32)
+        acc = acc + b32.reshape((1, -1) + (1,) * n)
+    return acc, lo, hi
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          num_outputs=3, differentiable=False)
+def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                      stride=None, pad=None, global_pool: bool = False,
+                      pooling_convention="valid", **_ignored):
+    """Pooling directly on int8 (reference quantized_pooling.cc) — ranges
+    pass through unchanged."""
+    n = len(kernel) if kernel else data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=axes, keepdims=True)
+        else:
+            out = jnp.mean(data.astype(jnp.float32), axis=axes,
+                           keepdims=True).astype(data.dtype)
+        return out, min_data, max_data
+    strides = _tup(stride, n)
+    pads = _tup(pad, n) if pad is not None else (0,) * n
+    dims = (1, 1) + tuple(kernel)
+    strd = (1, 1) + strides
+    padc = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if pool_type == "max":
+        init = jnp.iinfo(jnp.int8).min if data.dtype == jnp.int8 else 0
+        out = lax.reduce_window(data, jnp.asarray(init, data.dtype),
+                                lax.max, dims, strd, padc)
+    else:
+        s = lax.reduce_window(data.astype(jnp.float32), 0.0, lax.add,
+                              dims, strd, padc)
+        cnt = 1
+        for k in kernel:
+            cnt *= k
+        out = jnp.rint(s / cnt).astype(data.dtype)
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          num_outputs=3, differentiable=False)
+def quantized_flatten(data, min_data, max_data):
+    """(reference quantized_flatten.cc)"""
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_elemwise_add",
+          aliases=("quantized_elemwise_add",), num_outputs=3,
+          differentiable=False)
+def quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
+    """int8 + int8 → int32 with rescaling to a common unit (reference
+    quantized_elemwise_add.cc)."""
+    la = jnp.maximum(jnp.abs(min_lhs), jnp.abs(max_lhs)) / _INT8_MAX
+    ra = jnp.maximum(jnp.abs(min_rhs), jnp.abs(max_rhs)) / _INT8_MAX
+    out_unit = jnp.maximum(la, ra)
+    safe = jnp.where(out_unit > 0, out_unit, 1.0)
+    acc = (jnp.rint(lhs.astype(jnp.float32) * (la / safe)) +
+           jnp.rint(rhs.astype(jnp.float32) * (ra / safe))).astype(jnp.int32)
+    hi = out_unit * (2.0 ** 31 - 1)
+    return acc, -hi, hi
+
+
+@register("_contrib_quantized_act", aliases=("quantized_act",),
+          num_outputs=3, differentiable=False)
+def quantized_act(data, min_data, max_data, act_type: str = "relu"):
+    """ReLU on int8 (reference mkldnn quantized act path)."""
+    if act_type != "relu":
+        raise ValueError("only relu is supported quantized (like the "
+                         "reference's int8 path)")
+    out = jnp.maximum(data, 0).astype(data.dtype)
+    return out, jnp.maximum(min_data, 0.0), jnp.maximum(max_data, 0.0)

@@ -208,3 +208,32 @@ def test_mnist_iter_synthetic(tmp_path):
     b = next(it)
     assert b.data[0].shape == (5, 1, 28, 28)
     assert float(b.data[0].max().asscalar()) <= 1.0
+
+
+def _double_batchify(samples):
+    return onp.stack([onp.asarray(s[0]) * 2 for s in samples])
+
+
+def test_dataloader_multiprocessing_shm():
+    """Spawn-worker + shared-memory transport path (reference
+    dataloader.py:66-120 multiprocessing + shm design): values must match
+    the serial path exactly, across two epochs (pool reuse), including a
+    custom batchify_fn executed worker-side."""
+    x = onp.arange(36, dtype="float32").reshape(12, 3)
+    y = onp.arange(12, dtype="float32")
+    ds = gdata.ArrayDataset(x, y)
+    dl = gdata.DataLoader(ds, batch_size=4, num_workers=2)
+    for _ in range(2):  # two epochs through the same worker pool
+        got_x, got_y = [], []
+        for bx, by in dl:
+            got_x.append(bx.asnumpy())
+            got_y.append(by.asnumpy())
+        onp.testing.assert_allclose(onp.concatenate(got_x), x)
+        onp.testing.assert_allclose(onp.concatenate(got_y), y)
+    dl.close()
+
+    dl2 = gdata.DataLoader(ds, batch_size=6, num_workers=2,
+                           batchify_fn=_double_batchify)
+    out = onp.concatenate([b.asnumpy() for b in dl2])
+    onp.testing.assert_allclose(out, x * 2)
+    dl2.close()

@@ -1,0 +1,130 @@
+"""INT8 quantization: ops + quantize_model driver.
+
+Parity targets: ``src/operator/quantization/`` op semantics and
+``python/mxnet/contrib/quantization.py:423`` quantize_model with calib
+modes none/naive/entropy."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym as S
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib.quantization import quantize_model
+
+
+def _rand(*shape, scale=1.0, seed=0):
+    return (onp.random.RandomState(seed).randn(*shape) * scale).astype(
+        "float32")
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.array(_rand(5, 7))
+    q, mn, mxr = mx.nd.quantize_v2(x, out_type="int8")
+    assert q.dtype == onp.int8
+    back = mx.nd.dequantize(q, mn, mxr)
+    amax = float(onp.abs(x.asnumpy()).max())
+    assert onp.abs(back.asnumpy() - x.asnumpy()).max() <= amax / 127 + 1e-6
+
+
+def test_quantize_calibrated_clips():
+    x = mx.nd.array(onp.array([[-3.0, -0.5, 0.0, 0.5, 3.0]], "float32"))
+    q, mn, mxr = mx.nd.quantize_v2(x, min_calib_range=-1.0,
+                                   max_calib_range=1.0, out_type="int8")
+    back = mx.nd.dequantize(q, mn, mxr).asnumpy()
+    assert onp.allclose(back, [[-1.0, -0.5, 0.0, 0.5, 1.0]], atol=1e-2)
+
+
+def test_quantized_fully_connected_matches_float():
+    x = _rand(4, 16, seed=1)
+    w = _rand(8, 16, scale=0.3, seed=2)
+    b = _rand(8, scale=0.2, seed=3)
+    qx, xmn, xmx = mx.nd.quantize_v2(mx.nd.array(x), out_type="int8")
+    qw, wmn, wmx = mx.nd.quantize_v2(mx.nd.array(w), out_type="int8")
+    qb, bmn, bmx = mx.nd.quantize_v2(mx.nd.array(b), out_type="int8")
+    acc, amn, amx = mx.nd.quantized_fully_connected(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx, num_hidden=8)
+    assert acc.dtype == onp.int32
+    got = mx.nd.dequantize(acc, amn, amx).asnumpy()
+    want = x @ w.T + b
+    rel = onp.abs(got - want).max() / onp.abs(want).max()
+    assert rel < 0.05, rel
+
+
+def test_quantized_conv_matches_float():
+    x = _rand(2, 3, 8, 8, seed=4)
+    w = _rand(6, 3, 3, 3, scale=0.3, seed=5)
+    qx, xmn, xmx = mx.nd.quantize_v2(mx.nd.array(x), out_type="int8")
+    qw, wmn, wmx = mx.nd.quantize_v2(mx.nd.array(w), out_type="int8")
+    acc, amn, amx = mx.nd.quantized_conv(
+        qx, qw, None, xmn, xmx, wmn, wmx, xmn, xmx,
+        kernel=(3, 3), num_filter=6, pad=(1, 1), no_bias=True)
+    got = mx.nd.dequantize(acc, amn, amx).asnumpy()
+    want = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                             num_filter=6, pad=(1, 1),
+                             no_bias=True).asnumpy()
+    rel = onp.abs(got - want).max() / onp.abs(want).max()
+    assert rel < 0.05, rel
+
+
+def _small_convnet():
+    data = S.var("data")
+    c1 = S.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                       name="conv1")
+    r1 = S.Activation(c1, act_type="relu", name="relu1")
+    p1 = S.Pooling(r1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                   name="pool1")
+    f = S.Flatten(p1, name="flat")
+    fc = S.FullyConnected(f, num_hidden=10, name="fc1")
+    return fc
+
+
+@pytest.fixture(scope="module")
+def float_model():
+    sym = _small_convnet()
+    shapes, _, _ = sym.infer_shape(data=(4, 3, 8, 8))
+    rs = onp.random.RandomState(0)
+    args = {}
+    for name, shp in zip(sym.list_arguments(), shapes):
+        if name == "data":
+            continue
+        args[name] = mx.nd.array(
+            (rs.randn(*shp) * 0.2).astype("float32"))
+    return sym, args
+
+
+@pytest.mark.parametrize("mode", ["none", "naive", "entropy"])
+def test_quantize_model_forward_close(float_model, mode):
+    sym, args = float_model
+    rs = onp.random.RandomState(7)
+    data = mx.nd.array(rs.randn(4, 3, 8, 8).astype("float32"))
+    calib = mx.io.NDArrayIter({"data": data.asnumpy()}, batch_size=4) \
+        if mode != "none" else None
+    qsym, qargs, _ = quantize_model(
+        sym, args, {}, calib_mode=mode, calib_data=calib)
+    # offline-quantized int8 weights present, float originals gone
+    assert qargs["conv1_weight_quantize"].dtype == onp.int8
+    assert "conv1_weight" not in qargs
+    want = sym.eval_imperative({**args, "data": data}).asnumpy()
+    got = qsym.eval_imperative({**qargs, "data": data}).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < 0.12, (mode, rel)
+    # argmax (the classification decision) should mostly agree
+    agree = (got.argmax(1) == want.argmax(1)).mean()
+    assert agree >= 0.75, (mode, agree)
+
+
+def test_quantize_model_excluded_layer(float_model):
+    sym, args = float_model
+    qsym, qargs, _ = quantize_model(
+        sym, args, {}, calib_mode="none", excluded_sym_names=["fc1"])
+    # fc1 stays float: weights not quantized
+    assert "fc1_weight" in qargs and "fc1_weight_quantize" not in qargs
+    assert "conv1_weight_quantize" in qargs
+
+
+def test_quantize_model_bad_mode(float_model):
+    sym, args = float_model
+    with pytest.raises(MXNetError):
+        quantize_model(sym, args, {}, calib_mode="bogus")
+    with pytest.raises(MXNetError):
+        quantize_model(sym, args, {}, calib_mode="naive", calib_data=None)
