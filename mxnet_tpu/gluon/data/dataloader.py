@@ -114,6 +114,24 @@ def _shm_import_tree(meta, wrap):
     return meta[1]
 
 
+def _unlink_tree(meta):
+    """Free the shm segments named by an export-tree that will never be
+    imported (consumer stopped early).  Only the parent unlinks — workers
+    unregister from their resource trackers at export time."""
+    kind = meta[0]
+    if kind == "shm":
+        from multiprocessing import shared_memory
+        try:
+            seg = shared_memory.SharedMemory(name=meta[1])
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+    elif kind == "tree":
+        for m in meta[1]:
+            _unlink_tree(m)
+
+
 def _worker_fn(indices):
     samples = [_WORKER_DATASET[i] for i in indices]
     if _WORKER_BATCHIFY is not None:
@@ -160,6 +178,7 @@ class DataLoader:
         self._thread_pool = thread_pool
         self._executor = None
         self._pool = None
+        self._live_inflight = []   # in-flight shm batches per open iterator
         if self._num_workers > 0:
             if not thread_pool:
                 import pickle
@@ -224,29 +243,51 @@ class DataLoader:
                 pass
             yield f.result()
 
+    @staticmethod
+    def _reclaim(inflight):
+        """Unlink shm of batches that were produced but never consumed."""
+        for res in inflight:
+            try:
+                meta = res.get(timeout=10)
+            except Exception:
+                continue   # worker died / terminated mid-batch: no segment
+            _unlink_tree(meta)
+        inflight.clear()
+
     def _iter_mp(self):
         batches = iter(self._batch_sampler)
         inflight = []
+        self._live_inflight.append(inflight)
         try:
-            for _ in range(self._prefetch + 1):
-                inflight.append(
-                    self._pool.apply_async(_worker_fn, (next(batches),)))
-        except StopIteration:
-            pass
-        while inflight:
-            res = inflight.pop(0)
             try:
-                inflight.append(
-                    self._pool.apply_async(_worker_fn, (next(batches),)))
+                for _ in range(self._prefetch + 1):
+                    inflight.append(
+                        self._pool.apply_async(_worker_fn, (next(batches),)))
             except StopIteration:
                 pass
-            yield _shm_import_tree(res.get(), array)
+            while inflight:
+                res = inflight.pop(0)
+                try:
+                    inflight.append(
+                        self._pool.apply_async(_worker_fn, (next(batches),)))
+                except StopIteration:
+                    pass
+                yield _shm_import_tree(res.get(), array)
+        finally:
+            # consumer broke out / raised / generator collected: the
+            # already-exported segments would otherwise leak in /dev/shm
+            self._reclaim(inflight)
+            if inflight in self._live_inflight:
+                self._live_inflight.remove(inflight)
 
     def __len__(self):
         return len(self._batch_sampler)
 
     def close(self):
         if self._pool is not None:
+            for inflight in list(self._live_inflight):
+                self._reclaim(inflight)
+            self._live_inflight = []
             self._pool.terminate()
             self._pool.join()
             self._pool = None

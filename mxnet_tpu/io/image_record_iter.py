@@ -123,8 +123,10 @@ class _NativeImageRecordIter(DataIter):
             raise StopIteration
         data, labels, pad, errors = out
         if errors:
-            logging.warning("ImageRecordIter: %d undecodable records "
-                            "(zero-filled)", errors)
+            logging.warning(
+                "ImageRecordIter: %d undecodable records in batch "
+                "(zero image, label -1 — mask labels < 0 to exclude)",
+                errors)
         label = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch([array(data)], [array(label)], pad=pad)
 

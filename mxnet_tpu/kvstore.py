@@ -96,19 +96,41 @@ class KVStore:
         """Enable 2-bit error-feedback gradient compression on pushed
         gradients (reference kvstore.py:394 / gradient_compression.h:38).
         Gradients are quantized to {-t, 0, +t} before the cross-worker
-        reduction; the quantization error feeds back into the next push."""
+        reduction; the quantization error feeds back into the next push.
+
+        Only device/dist store types accept compression, matching the
+        reference (kvstore.py:423 raises for 'local').  The error-feedback
+        residual is host state, so compressed push is EAGER-ONLY: pushing
+        inside a jitted step would capture tracers in the residual dict.
+        """
+        if not self._supports_compression():
+            raise MXNetError(
+                "Gradient compression is not supported for this type of "
+                "kvstore: %s" % self.type)
         from .gradient_compression import GradientCompression
         self._gc = GradientCompression(compression_params)
         self._compression_params = self._gc.get_params()
+
+    def _supports_compression(self):
+        # the reference accepts compression on device/dist stores and
+        # raises for plain 'local' (kvstore.py:423)
+        return self.type != "local"
 
     def _compress_grad(self, key, value):
         """Apply configured compression to one pushed gradient NDArray."""
         gc = getattr(self, "_gc", None)
         if gc is None:
             return value
+        import jax.core as _jcore
+        raw = value._data if isinstance(value, NDArray) else value
+        if isinstance(raw, _jcore.Tracer):
+            raise MXNetError(
+                "compressed push is eager-only: the error-feedback residual "
+                "is host state and cannot carry traced values; push outside "
+                "jit or disable gradient compression")
         if isinstance(value, NDArray):
             from .ndarray.ndarray import _wrap
-            return _wrap(gc.compress(key, value._data))
+            return _wrap(gc.compress(key, raw))
         return gc.compress(key, value)
 
     def set_optimizer(self, optimizer):
@@ -223,6 +245,10 @@ class KVStoreTPU(KVStoreLocal):
 
     def __init__(self, type_str="tpu"):
         super().__init__(type_str)
+
+    def _supports_compression(self):
+        # reference: only device/dist stores compress (kvstore.py:423)
+        return True
 
     def _transform_grad(self, key, value):
         # compress (worker-side, reference kvstore_dist.h:361), then

@@ -1,13 +1,18 @@
-"""Learning-rate schedulers.
+"""Learning-rate schedules as pure functions of the update count.
 
-Reference: ``python/mxnet/lr_scheduler.py:22-238`` — LRScheduler base with
-warmup, FactorScheduler, MultiFactorScheduler, PolyScheduler, CosineScheduler.
-Schedulers here are pure functions of the update count, so they can be called
-both eagerly (Trainer/Module path) and inside a jitted train step (where the
-step counter is a traced scalar) — the TPU-friendly formulation.
+Capability parity with ``python/mxnet/lr_scheduler.py:22-238`` (LRScheduler
+base with warmup, Factor/MultiFactor/Poly/Cosine), re-designed stateless:
+the reference mutates ``base_lr`` as training progresses, which cannot be
+traced; here every schedule is a closed-form map ``num_update -> lr``.
+That makes the same object usable eagerly (Trainer/Module path) and inside
+a jitted train step where the step counter is a traced scalar — the
+TPU-friendly formulation.  ``base_lr`` stays a plain attribute so callers
+(e.g. Optimizer, which overwrites it with its learning_rate) can adjust it
+at any time.
 """
 from __future__ import annotations
 
+import bisect
 import math
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
@@ -15,143 +20,146 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 
 
 class LRScheduler:
-    """Base scheduler with linear/constant warmup (reference
-    lr_scheduler.py:22)."""
+    """Base: holds ``base_lr`` and the warmup ramp (reference
+    lr_scheduler.py:22).  Subclasses implement ``_decayed_lr`` for the
+    post-warmup region."""
 
     def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
                  warmup_mode="linear"):
+        if not isinstance(warmup_steps, int) or warmup_steps < 0:
+            raise ValueError("warmup_steps must be a non-negative int, got %r"
+                             % (warmup_steps,))
+        if warmup_begin_lr > base_lr:
+            raise ValueError(
+                "warmup must ramp up: warmup_begin_lr %g exceeds base_lr %g"
+                % (warmup_begin_lr, base_lr))
+        if warmup_mode not in ("linear", "constant"):
+            raise ValueError("warmup_mode must be 'linear' or 'constant', "
+                             "got %r" % (warmup_mode,))
         self.base_lr = base_lr
-        assert isinstance(warmup_steps, int)
         self.warmup_steps = warmup_steps
-        self.warmup_final_lr = base_lr
         self.warmup_begin_lr = warmup_begin_lr
-        if self.warmup_begin_lr > self.warmup_final_lr:
-            raise ValueError("Base lr has to be higher than warmup_begin_lr")
-        if self.warmup_steps < 0:
-            raise ValueError("Warmup steps has to be positive or 0")
-        if warmup_mode not in ["linear", "constant"]:
-            raise ValueError("Supports only linear and constant modes of warmup")
         self.warmup_mode = warmup_mode
+
+    @property
+    def warmup_final_lr(self):
+        return self.base_lr
 
     def get_warmup_lr(self, num_update):
         assert num_update < self.warmup_steps
-        if self.warmup_mode == "linear":
-            increase = (self.warmup_final_lr - self.warmup_begin_lr) \
-                * float(num_update) / float(self.warmup_steps)
-            return self.warmup_begin_lr + increase
         if self.warmup_mode == "constant":
             return self.warmup_begin_lr
-        raise ValueError("Invalid warmup mode %s" % self.warmup_mode)
+        ramp = num_update / float(self.warmup_steps)
+        return self.warmup_begin_lr + ramp * (self.base_lr
+                                              - self.warmup_begin_lr)
+
+    def _decayed_lr(self, num_update):
+        raise NotImplementedError()
 
     def __call__(self, num_update):
-        raise NotImplementedError("__call__ must be overridden.")
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._decayed_lr(num_update)
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (reference lr_scheduler.py:78)."""
+    """Geometric decay: one ``factor`` multiply per ``step`` updates,
+    floored at ``stop_factor_lr`` (reference lr_scheduler.py:78).
+
+    Closed form: after ``n`` updates the lr has decayed
+    ``floor((n-1)/step)`` times.
+    """
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8, base_lr=0.01,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
+            raise ValueError("decay interval must cover at least 1 update, "
+                             "got step=%r" % (step,))
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("a decay factor above 1 would grow the lr, "
+                             "got %r" % (factor,))
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-        return self.base_lr
+    def _decayed_lr(self, num_update):
+        n_decays = max(0, num_update - 1) // self.step
+        return max(self.stop_factor_lr, self.base_lr
+                   * self.factor ** n_decays)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each milestone in `step` (reference
-    lr_scheduler.py:127)."""
+    """One ``factor`` multiply as each milestone in ``step`` is passed
+    (reference lr_scheduler.py:127).  Closed form: the decay count is the
+    number of milestones strictly below ``num_update`` (bisect)."""
 
     def __init__(self, step, factor=1, base_lr=0.01, warmup_steps=0,
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1 round")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("milestones must cover at least 1 update")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("milestones must be strictly increasing")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("a decay factor above 1 would grow the lr, "
+                             "got %r" % (factor,))
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _decayed_lr(self, num_update):
+        n_decays = bisect.bisect_left(self.step, num_update)
+        return self.base_lr * self.factor ** n_decays
 
 
-class PolyScheduler(LRScheduler):
-    """Polynomial decay to final_lr over max_update (reference
-    lr_scheduler.py:170)."""
-
-    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
-                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.power = pwr
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
-
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                pow(1 - float(num_update - self.warmup_steps) / float(self.max_steps),
-                    self.power)
-        return self.base_lr
-
-
-class CosineScheduler(LRScheduler):
-    """Cosine decay to final_lr over max_update (reference
-    lr_scheduler.py:205)."""
+class _HorizonScheduler(LRScheduler):
+    """Shared shape for Poly/Cosine: interpolate base_lr → final_lr over
+    the (warmup-excluded) horizon, then hold final."""
 
     def __init__(self, max_update, base_lr=0.01, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update must be a positive int, got %r"
+                             % (max_update,))
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 + math.cos(math.pi * (num_update - self.warmup_steps) /
-                              self.max_steps)) / 2
-        return self.base_lr
+    @property
+    def max_steps(self):
+        return self.max_update - self.warmup_steps
+
+    def _progress(self, num_update):
+        return (num_update - self.warmup_steps) / float(self.max_steps)
+
+    def _decayed_lr(self, num_update):
+        if num_update > self.max_update:
+            num_update = self.max_update
+        span = self.base_lr - self.final_lr
+        return self.final_lr + span * self._shape(self._progress(num_update))
+
+    def _shape(self, t):
+        """Decay envelope on t ∈ [0, 1], from 1 down to 0."""
+        raise NotImplementedError()
+
+
+class PolyScheduler(_HorizonScheduler):
+    """(1 - t)^pwr decay to final_lr (reference lr_scheduler.py:170)."""
+
+    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
+                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(max_update, base_lr, final_lr, warmup_steps,
+                         warmup_begin_lr, warmup_mode)
+        self.power = pwr
+
+    def _shape(self, t):
+        return (1.0 - t) ** self.power
+
+
+class CosineScheduler(_HorizonScheduler):
+    """Half-cosine decay to final_lr (reference lr_scheduler.py:205)."""
+
+    def _shape(self, t):
+        return (1.0 + math.cos(math.pi * t)) / 2.0

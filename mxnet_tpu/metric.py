@@ -1,11 +1,20 @@
 """Evaluation metrics.
 
-Reference: ``python/mxnet/metric.py:68-1610`` — ``EvalMetric`` registry +
-Accuracy/TopK/F1/MCC/Perplexity/MAE/MSE/RMSE/CrossEntropy/NLL/Pearson/Loss/
-Composite/Custom metrics.  Metric math runs on host numpy: metrics consume
-already-computed predictions, so keeping them off-device avoids tiny TPU
-dispatches in the eval loop (the reference likewise computes on CPU via
-``asnumpy``).
+Capability parity with ``python/mxnet/metric.py`` (reference :68-1610):
+EvalMetric registry + Accuracy/TopK/F1/MCC/Perplexity/MAE/MSE/RMSE/
+CrossEntropy/NLL/Pearson/Loss/Composite/Custom metrics.
+
+Design (TPU rebuild, original implementation):
+
+* metric math runs on host numpy — metrics consume already-computed
+  predictions, and keeping them off-device avoids tiny TPU dispatches in
+  the eval loop;
+* one template base ``_PairMetric`` owns the label/pred pairing loop and
+  the dual (window, run-total) accumulators; concrete metrics implement a
+  single vectorized ``_measure(label, pred) -> (sum, count)``;
+* ``reset_local``/``get_global`` come from the dual accumulators: every
+  update feeds both, ``reset_local`` clears only the window;
+* confusion-based metrics (F1, MCC) share a bincount confusion matrix.
 """
 from __future__ import annotations
 
@@ -14,8 +23,6 @@ from collections import OrderedDict
 
 import numpy
 
-from .base import MXNetError
-
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
@@ -23,19 +30,21 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    """(reference metric.py:37) Check label/pred count match."""
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    """Validate that labels and predictions pair up (reference metric.py:37).
+
+    With ``shape=False`` compares counts (list lengths); with ``shape=True``
+    compares array shapes.  ``wrap=True`` additionally listifies bare
+    arrays so callers can iterate uniformly.
+    """
+    lhs = labels.shape if shape else len(labels)
+    rhs = preds.shape if shape else len(preds)
+    if lhs != rhs:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(lhs, rhs))
     if wrap:
-        if not isinstance(labels, (list, tuple)):
-            labels = [labels]
-        if not isinstance(preds, (list, tuple)):
-            preds = [preds]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
     return labels, preds
 
 
@@ -44,92 +53,122 @@ def _asnumpy(x):
 
 
 class EvalMetric:
-    """Base metric (reference metric.py:68)."""
+    """Base metric (reference metric.py:68).
+
+    Subclasses either override ``update`` wholesale or (via ``_PairMetric``)
+    implement ``_measure``.  All accumulation goes through ``_accumulate``,
+    which feeds two (sum, count) cells: the *window* (cleared by
+    ``reset_local``, read by ``get``) and the *run total* (cleared only by
+    ``reset``, read by ``get_global``).
+    """
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
         self.output_names = output_names
         self.label_names = label_names
-        self._kwargs = kwargs
+        self._init_kwargs = kwargs
         self.reset()
 
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
-    def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
-        return config
+    # -- accumulation ---------------------------------------------------
+    def reset(self):
+        self._win = [0.0, 0]
+        self._run = [0.0, 0]
 
-    def update_dict(self, label, pred):
-        """Update from {name: array} dicts, filtering by output/label names
-        (reference metric.py:131)."""
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+    def reset_local(self):
+        self._win = [0.0, 0]
 
+    def _accumulate(self, value, count):
+        self._win[0] += value
+        self._win[1] += count
+        self._run[0] += value
+        self._run[1] += count
+
+    # Back-compat accessors: the reference exposes raw accumulators and a
+    # few callers/tests poke them.  They view/overwrite the window cell.
+    @property
+    def sum_metric(self):
+        return self._win[0]
+
+    @sum_metric.setter
+    def sum_metric(self, v):
+        self._win[0] = v
+
+    @property
+    def num_inst(self):
+        return self._win[1]
+
+    @num_inst.setter
+    def num_inst(self, v):
+        self._win[1] = v
+
+    # -- reading --------------------------------------------------------
+    def _finalize(self, mean):
+        """Hook: map the accumulated mean to the reported value."""
+        return mean
+
+    def _read(self, cell):
+        total, count = cell
+        if count == 0:
+            return (self.name, float("nan"))
+        return (self.name, self._finalize(total / count))
+
+    def get(self):
+        return self._read(self._win)
+
+    def get_global(self):
+        return self._read(self._run)
+
+    def _pairs(self, reading):
+        name, value = reading
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
+
+    def get_name_value(self):
+        return self._pairs(self.get())
+
+    def get_global_name_value(self):
+        return self._pairs(self.get_global())
+
+    # -- updating -------------------------------------------------------
     def update(self, labels, preds):
         raise NotImplementedError()
 
-    def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
-        self._local_sum_offset = 0.0
-        self._local_num_offset = 0
+    def update_dict(self, label, pred):
+        """Update from {name: array} dicts, selecting this metric's
+        output/label names when set (reference metric.py:131)."""
+        if self.output_names is None:
+            outs = list(pred.values())
+        else:
+            outs = [pred[n] for n in self.output_names]
+        if self.label_names is None:
+            labs = list(label.values())
+        else:
+            labs = [label[n] for n in self.label_names]
+        self.update(labs, outs)
 
-    def reset_local(self):
-        """Clear only the recent window (reference metric.py reset_local):
-        ``get()`` then reports values since this call, ``get_global()`` the
-        run total.  Implemented as offsets into the monotonic accumulators
-        so subclasses need no changes."""
-        self._local_sum_offset = self.sum_metric
-        self._local_num_offset = self.num_inst
+    def get_config(self):
+        config = dict(self._init_kwargs)
+        config.update(metric=self.__class__.__name__, name=self.name,
+                      output_names=self.output_names,
+                      label_names=self.label_names)
+        return config
 
-    def _local_offsets(self):
-        off_s = getattr(self, "_local_sum_offset", 0.0)
-        off_n = getattr(self, "_local_num_offset", 0)
-        if off_n > self.num_inst:  # a subclass reset() skipped the offsets
-            return 0.0, 0
-        return off_s, off_n
 
-    def get(self):
-        off_s, off_n = self._local_offsets()
-        num = self.num_inst - off_n
-        if num == 0:
-            return (self.name, float("nan"))
-        return (self.name, (self.sum_metric - off_s) / num)
+class _PairMetric(EvalMetric):
+    """Template for metrics that consume (label, pred) array pairs."""
 
-    def get_global(self):
-        """Run-total value ignoring reset_local (reference get_global)."""
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for lab, prd in zip(labels, preds):
+            value, count = self._measure(_asnumpy(lab), _asnumpy(prd))
+            self._accumulate(value, count)
 
-    def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
-
-    def get_global_name_value(self):
-        name, value = self.get_global()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+    def _measure(self, label, pred):
+        raise NotImplementedError()
 
 
 # ---------------------------------------------------------------------------
@@ -145,107 +184,94 @@ def register(klass):
     return klass
 
 
-def alias(*aliases):
-    def reg(klass):
-        for a in aliases:
-            _METRIC_REGISTRY[a.lower()] = klass
+def alias(*names):
+    def _register(klass):
+        for n in names:
+            _METRIC_REGISTRY[n.lower()] = klass
         return register(klass)
-    return reg
+    return _register
 
 
 def create(metric, *args, **kwargs):
-    """Create a metric from name / callable / list (reference metric.py:201)."""
+    """Build a metric from a name, callable, instance, or list of those
+    (reference metric.py:201)."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
-    if isinstance(metric, CompositeEvalMetric):
-        return metric
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite = CompositeEvalMetric()
-        for child in metric:
-            composite.add(create(child, *args, **kwargs))
-        return composite
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, *args, **kwargs))
+        return out
     if isinstance(metric, str):
-        try:
-            return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
-        except KeyError:
-            raise ValueError("Metric must be either callable or in registry %s"
-                             % sorted(_METRIC_REGISTRY))
+        key = metric.lower()
+        if key not in _METRIC_REGISTRY:
+            raise ValueError(
+                "Metric must be either callable or in registry %s"
+                % sorted(_METRIC_REGISTRY))
+        return _METRIC_REGISTRY[key](*args, **kwargs)
     raise TypeError("metric should be callable, str, or EvalMetric instance")
 
 
 @register
 class CompositeEvalMetric(EvalMetric):
-    """Manage multiple metrics as one (reference metric.py:262)."""
+    """Fan updates out to several child metrics (reference metric.py:262)."""
 
     def __init__(self, metrics=None, name="composite",
                  output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
 
     def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+        if not 0 <= index < len(self.metrics):
+            raise ValueError(
+                "Metric index {} is out of range 0 and {}".format(
+                    index, len(self.metrics)))
+        return self.metrics[index]
 
     def update_dict(self, labels, preds):
         if self.label_names is not None:
-            labels = OrderedDict([i for i in labels.items()
-                                  if i[0] in self.label_names])
+            labels = OrderedDict(
+                (k, v) for k, v in labels.items() if k in self.label_names)
         if self.output_names is not None:
-            preds = OrderedDict([i for i in preds.items()
-                                 if i[0] in self.output_names])
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+            preds = OrderedDict(
+                (k, v) for k, v in preds.items() if k in self.output_names)
+        for m in self.metrics:
+            m.update_dict(labels, preds)
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for m in self.metrics:
+            m.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for m in getattr(self, "metrics", []):
+            m.reset()
 
     def reset_local(self):
-        try:
-            for metric in self.metrics:
-                metric.reset_local()
-        except AttributeError:
-            pass
+        for m in getattr(self, "metrics", []):
+            m.reset_local()
 
-    def _gather(self, getter):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = getter(metric)
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (int, float)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+    def _concat(self, readings):
+        names, values = [], []
+        for name, value in readings:
+            names.extend(name if isinstance(name, list) else [name])
+            values.extend(value if isinstance(value, list) else [value])
         return (names, values)
 
     def get(self):
-        return self._gather(lambda m: m.get())
+        return self._concat(m.get() for m in self.metrics)
 
     def get_global(self):
-        return self._gather(lambda m: m.get_global())
+        return self._concat(m.get_global() for m in self.metrics)
 
     def get_config(self):
         config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        config["metrics"] = [m.get_config() for m in self.metrics]
         return config
 
 
@@ -253,202 +279,185 @@ class CompositeEvalMetric(EvalMetric):
 # classification metrics
 # ---------------------------------------------------------------------------
 
+def _class_predictions(label, pred, axis=-1):
+    """Collapse class scores to predicted indices when shapes differ."""
+    if pred.shape != label.shape:
+        pred = pred.argmax(axis=axis)
+    return label.astype("int64").ravel(), pred.astype("int64").ravel()
+
+
 @alias("acc")
-class Accuracy(EvalMetric):
-    """Classification accuracy (reference metric.py:339)."""
+class Accuracy(_PairMetric):
+    """Fraction of exactly-matched predictions (reference metric.py:339)."""
 
     def __init__(self, axis=1, name="accuracy",
                  output_names=None, label_names=None):
-        super().__init__(name, axis=axis,
-                         output_names=output_names, label_names=label_names)
+        super().__init__(name, axis=axis, output_names=output_names,
+                         label_names=label_names)
         self.axis = axis
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            pred_label = _asnumpy(pred_label)
-            label = _asnumpy(label)
-            if pred_label.shape != label.shape:
-                pred_label = pred_label.argmax(axis=self.axis)
-            pred_label = pred_label.astype("int32")
-            label = label.astype("int32")
-            label = label.flat
-            pred_label = pred_label.flat
-            check_label_shapes(label, pred_label)
-            num_correct = (pred_label == label).sum()
-            self.sum_metric += num_correct
-            self.num_inst += len(pred_label)
+    def _measure(self, label, pred):
+        lab, prd = _class_predictions(label, pred, self.axis)
+        check_label_shapes(lab, prd, shape=True)
+        return float((lab == prd).sum()), lab.size
 
 
 @alias("top_k_accuracy", "top_k_acc")
-class TopKAccuracy(EvalMetric):
-    """Top-k accuracy (reference metric.py:407)."""
+class TopKAccuracy(_PairMetric):
+    """Label-in-top-k rate (reference metric.py:407)."""
 
     def __init__(self, top_k=1, name="top_k_accuracy",
                  output_names=None, label_names=None):
-        super().__init__(name, top_k=top_k,
-                         output_names=output_names, label_names=label_names)
+        super().__init__(name, top_k=top_k, output_names=output_names,
+                         label_names=label_names)
+        assert top_k > 1, "Please use Accuracy if top_k is no more than 1"
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+        self.name = "{}_{}".format(self.name, top_k)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argpartition(
-                _asnumpy(pred_label).astype("float32"), -self.top_k)
-            label = _asnumpy(label).astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    num_correct = (pred_label[:, num_classes - 1 - j].flat ==
-                                   label.flat).sum()
-                    self.sum_metric += num_correct
-            self.num_inst += num_samples
+    def _measure(self, label, pred):
+        assert pred.ndim <= 2, "Predictions should be no more than 2 dims"
+        lab = label.astype("int64").ravel()
+        if pred.ndim == 1:
+            hits = (pred.astype("int64") == lab).sum()
+            return float(hits), lab.size
+        k = min(self.top_k, pred.shape[1])
+        top = numpy.argpartition(pred.astype("float32"), -k, axis=1)[:, -k:]
+        hits = (top == lab[:, None]).any(axis=1).sum()
+        return float(hits), pred.shape[0]
 
 
-class _BinaryClassificationMetrics:
-    """Confusion-matrix accumulators for F1/MCC (reference metric.py:478)."""
+class _ConfusionCounts:
+    """2-class confusion matrix built with one bincount per batch."""
+
+    __slots__ = ("tn", "fp", "fn", "tp")
 
     def __init__(self):
-        self.reset_stats()
+        self.clear()
 
-    def update_binary_stats(self, label, pred):
-        pred = _asnumpy(pred)
-        label = _asnumpy(label).astype("int32")
-        pred_label = numpy.argmax(pred, axis=1)
-        check_label_shapes(label, pred)
-        if len(numpy.unique(label)) > 2:
-            raise ValueError("%s currently only supports binary classification."
-                             % self.__class__.__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label == 1)
-        label_false = 1 - label_true
-        true_pos = (pred_true * label_true).sum()
-        false_pos = (pred_true * label_false).sum()
-        false_neg = (pred_false * label_true).sum()
-        true_neg = (pred_false * label_false).sum()
-        self.true_positives += true_pos
-        self.false_positives += false_pos
-        self.false_negatives += false_neg
-        self.true_negatives += true_neg
+    def clear(self):
+        self.tn = self.fp = self.fn = self.tp = 0
+
+    def add_batch(self, label, pred):
+        lab, prd = _class_predictions(label, pred, axis=1)
+        check_label_shapes(lab, prd, shape=True)
+        if numpy.unique(lab).size > 2:
+            raise ValueError(
+                "binary classification metric got >2 label classes")
+        cells = numpy.bincount(2 * (lab != 0) + (prd != 0), minlength=4)
+        self.tn += int(cells[0])
+        self.fp += int(cells[1])
+        self.fn += int(cells[2])
+        self.tp += int(cells[3])
+
+    @property
+    def total(self):
+        return self.tn + self.fp + self.fn + self.tp
 
     @property
     def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (self.true_positives + self.false_positives)
-        return 0.0
+        marked = self.tp + self.fp
+        return self.tp / marked if marked else 0.0
 
     @property
     def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (self.true_positives + self.false_negatives)
-        return 0.0
+        actual = self.tp + self.fn
+        return self.tp / actual if actual else 0.0
 
     @property
     def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (self.precision + self.recall)
-        return 0.0
+        pr = self.precision + self.recall
+        return 2.0 * self.precision * self.recall / pr if pr else 0.0
 
     @property
     def matthewscc(self):
-        if not self.total_examples:
+        if not self.total:
             return 0.0
-        true_pos = float(self.true_positives)
-        false_pos = float(self.false_positives)
-        false_neg = float(self.false_negatives)
-        true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos),
-                 (true_pos + false_neg),
-                 (true_neg + false_pos),
-                 (true_neg + false_neg)]
+        sides = [self.tp + self.fp, self.tp + self.fn,
+                 self.tn + self.fp, self.tn + self.fn]
         denom = 1.0
-        for t in filter(lambda t: t != 0.0, terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(denom)
+        for s in sides:
+            if s:
+                denom *= float(s)
+        return (self.tp * self.tn - self.fp * self.fn) / math.sqrt(denom)
 
-    @property
-    def total_examples(self):
-        return self.false_negatives + self.false_positives + \
-            self.true_negatives + self.true_positives
 
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+class _ConfusionMetric(EvalMetric):
+    """Shared machinery for F1/MCC: macro averages per-batch scores, micro
+    keeps a running confusion matrix and scores it at read time."""
+
+    _stat = None  # property name on _ConfusionCounts
+
+    def __init__(self, name, average="macro", output_names=None,
+                 label_names=None):
+        self._average = average
+        self._win_counts = _ConfusionCounts()
+        self._run_counts = _ConfusionCounts()
+        super().__init__(name, average=average, output_names=output_names,
+                         label_names=label_names)
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "_win_counts"):
+            self._win_counts.clear()
+            self._run_counts.clear()
+
+    def reset_local(self):
+        super().reset_local()
+        self._win_counts.clear()
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for lab, prd in zip(labels, preds):
+            lab, prd = _asnumpy(lab), _asnumpy(prd)
+            if self._average == "macro":
+                batch = _ConfusionCounts()
+                batch.add_batch(lab, prd)
+                self._accumulate(getattr(batch, self._stat), 1)
+            else:
+                self._win_counts.add_batch(lab, prd)
+                self._run_counts.add_batch(lab, prd)
+
+    def _read(self, cell):
+        if self._average == "macro":
+            return super()._read(cell)
+        counts = self._win_counts if cell is self._win else self._run_counts
+        if not counts.total:
+            return (self.name, float("nan"))
+        return (self.name, getattr(counts, self._stat))
 
 
 @register
-class F1(EvalMetric):
-    """F1 score for binary classification (reference metric.py:564)."""
+class F1(_ConfusionMetric):
+    """Binary F1 (reference metric.py:564)."""
+
+    _stat = "fscore"
 
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
-        self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name,
-                            output_names=output_names, label_names=label_names)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
-        if self.average == "macro":
-            self.sum_metric += self.metrics.fscore
-            self.num_inst += 1
-            self.metrics.reset_stats()
-        else:
-            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
-            self.num_inst = self.metrics.total_examples
-
-    def reset(self):
-        super().reset()
-        if hasattr(self, "metrics"):
-            self.metrics.reset_stats()
+        super().__init__(name, average=average, output_names=output_names,
+                         label_names=label_names)
 
 
 @register
-class MCC(EvalMetric):
+class MCC(_ConfusionMetric):
     """Matthews correlation coefficient (reference metric.py:639)."""
+
+    _stat = "matthewscc"
 
     def __init__(self, name="mcc", output_names=None, label_names=None,
                  average="macro"):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name,
-                            output_names=output_names, label_names=label_names)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc
-            self.num_inst += 1
-            self._metrics.reset_stats()
-        else:
-            self.sum_metric = self._metrics.matthewscc * self._metrics.total_examples
-            self.num_inst = self._metrics.total_examples
-
-    def reset(self):
-        super().reset()
-        if hasattr(self, "_metrics"):
-            self._metrics.reset_stats()
+        super().__init__(name, average=average, output_names=output_names,
+                         label_names=label_names)
 
 
 @register
-class Perplexity(EvalMetric):
-    """Perplexity (reference metric.py:761)."""
+class Perplexity(_PairMetric):
+    """exp of the mean per-token log-loss (reference metric.py:761).
+
+    Accumulates raw log-loss and token counts so multi-batch evaluation is
+    exact — ``get`` exponentiates the pooled mean, never averages
+    per-batch perplexities.
+    """
 
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
@@ -457,189 +466,139 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.reshape((label.size,)).astype("int32")
-            probs = pred.reshape(-1, pred.shape[-1])[numpy.arange(label.size), label]
-            if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= numpy.sum(ignore)
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label.size
-        # accumulate raw log-loss; get() exponentiates the global mean so
-        # multi-batch evaluation is exact (reference metric.py:826)
-        self.sum_metric += loss
-        self.num_inst += num
+    def _measure(self, label, pred):
+        classes = pred.shape[-1]
+        if label.size * classes != pred.size:
+            raise ValueError("shape mismatch: %s vs. %s"
+                             % (label.shape, pred.shape))
+        lab = label.astype("int64").ravel()
+        probs = pred.reshape(-1, classes)[numpy.arange(lab.size), lab]
+        keep = numpy.ones_like(probs, dtype=bool)
+        if self.ignore_label is not None:
+            keep = lab != self.ignore_label
+        logloss = -numpy.log(
+            numpy.maximum(probs[keep], 1e-10)).sum()
+        return float(logloss), int(keep.sum())
 
-    def get(self):
-        off_s, off_n = self._local_offsets()
-        num = self.num_inst - off_n
-        if num == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp((self.sum_metric - off_s) / num))
-
-    def get_global(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+    def _finalize(self, mean):
+        return math.exp(mean)
 
 
 # ---------------------------------------------------------------------------
 # regression metrics
 # ---------------------------------------------------------------------------
 
+class _RegressionMetric(_PairMetric):
+    """Per-batch scalar over the elementwise error (count = 1/batch)."""
+
+    def __init__(self, name, output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    def _measure(self, label, pred):
+        err = label.astype("float64") - pred.astype("float64").reshape(
+            label.shape)
+        return self._score(err), 1
+
+    def _score(self, err):
+        raise NotImplementedError()
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_RegressionMetric):
     """Mean absolute error (reference metric.py:835)."""
 
-    def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    def _score(self, err):
+        return float(numpy.abs(err).mean())
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_RegressionMetric):
     """Mean squared error (reference metric.py:887)."""
 
-    def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def _score(self, err):
+        return float(numpy.square(err).mean())
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_RegressionMetric):
     """Root mean squared error (reference metric.py:939)."""
 
-    def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    def _score(self, err):
+        return float(math.sqrt(numpy.square(err).mean()))
+
+
+class _TrueClassLogLoss(_PairMetric):
+    """Shared by CrossEntropy/NLL: -log p[true class], averaged per row."""
+
+    def __init__(self, eps, name, **kwargs):
+        super().__init__(name, eps=eps, **kwargs)
+        self.eps = eps
+
+    def _measure(self, label, pred):
+        lab = label.astype("int64").ravel()
+        if lab.shape[0] != pred.shape[0]:
+            raise ValueError("label rows %d != pred rows %d"
+                             % (lab.shape[0], pred.shape[0]))
+        picked = pred[numpy.arange(lab.shape[0]), lab]
+        return float(-numpy.log(picked + self.eps).sum()), lab.shape[0]
 
 
 @alias("ce")
-class CrossEntropy(EvalMetric):
+class CrossEntropy(_TrueClassLogLoss):
     """Cross entropy over softmax outputs (reference metric.py:991)."""
 
-    def __init__(self, eps=1e-12, name="cross-entropy",
-                 output_names=None, label_names=None):
-        super().__init__(name, eps=eps,
-                         output_names=output_names, label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(eps, name, **kwargs)
 
 
 @alias("nll_loss")
-class NegativeLogLikelihood(EvalMetric):
+class NegativeLogLikelihood(_TrueClassLogLoss):
     """NLL over probability outputs (reference metric.py:1053)."""
 
-    def __init__(self, eps=1e-12, name="nll-loss",
-                 output_names=None, label_names=None):
-        super().__init__(name, eps=eps,
-                         output_names=output_names, label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps, name, **kwargs)
 
 
 @alias("pearsonr")
-class PearsonCorrelation(EvalMetric):
-    """Pearson correlation (reference metric.py:1115)."""
+class PearsonCorrelation(_PairMetric):
+    """Pearson correlation per batch (reference metric.py:1115)."""
 
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, False, True)
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            self.sum_metric += numpy.corrcoef(pred.ravel(), label.ravel())[0, 1]
-            self.num_inst += 1
+    def _measure(self, label, pred):
+        check_label_shapes(label, pred, shape=True)
+        return float(numpy.corrcoef(pred.ravel(), label.ravel())[0, 1]), 1
 
 
 @register
 class Loss(EvalMetric):
-    """Mean of a loss output (reference metric.py:1158)."""
+    """Mean of a (pre-computed) loss output (reference metric.py:1158)."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
     def update(self, _, preds):
-        if isinstance(preds, (list, tuple)):
-            pass
-        else:
-            preds = [preds]
-        for pred in preds:
-            loss = _asnumpy(pred).sum()
-            self.sum_metric += loss
-            self.num_inst += numpy.prod(numpy.asarray(pred.shape)) if hasattr(pred, "shape") else 1
+        for pred in preds if isinstance(preds, (list, tuple)) else [preds]:
+            arr = _asnumpy(pred)
+            self._accumulate(float(arr.sum()), arr.size)
 
 
 @register
 class Torch(Loss):
-    """(reference metric.py:1189 — renamed Loss)"""
+    """Alias of Loss kept for reference API parity (metric.py:1189)."""
 
     def __init__(self, name="torch", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -647,7 +606,7 @@ class Torch(Loss):
 
 @register
 class Caffe(Loss):
-    """(reference metric.py:1199)"""
+    """Alias of Loss kept for reference API parity (metric.py:1199)."""
 
     def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
@@ -655,41 +614,38 @@ class Caffe(Loss):
 
 @register
 class CustomMetric(EvalMetric):
-    """Metric from a feval function (reference metric.py:1209)."""
+    """Metric from a user feval(label, pred) function (reference
+    metric.py:1209).  feval may return a scalar or a (sum, count) pair."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
-            name = feval.__name__
-            if name.find("<") != -1:
+            name = getattr(feval, "__name__", "custom")
+            if "<" in name:
                 name = "custom(%s)" % name
-        super().__init__(name, feval=feval, allow_extra_outputs=allow_extra_outputs,
+        super().__init__(name, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs,
                          output_names=output_names, label_names=label_names)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
-            labels, preds = check_label_shapes(labels, preds, True)
-        for pred, label in zip(preds, labels):
-            label = _asnumpy(label)
-            pred = _asnumpy(pred)
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+            labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for prd, lab in zip(preds, labels):
+            result = self._feval(_asnumpy(lab), _asnumpy(prd))
+            if isinstance(result, tuple):
+                self._accumulate(*result)
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                self._accumulate(result, 1)
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy feval as a metric (reference metric.py:1281)."""
+    """Wrap a bare numpy feval as a CustomMetric (reference metric.py:1281)."""
     def feval(label, pred):
         return numpy_feval(label, pred)
-    feval.__name__ = numpy_feval.__name__
+    feval.__name__ = getattr(numpy_feval, "__name__", "feval")
     return CustomMetric(feval, name, allow_extra_outputs)

@@ -351,9 +351,11 @@ void worker_loop(Pipeline* p) {
                 ir_parse(data, len, &ir);
       float* out = slot->data.data() + uint64_t(i) * per_img;
       float* lab = slot->labels.data() + uint64_t(i) * p->label_width;
+      // corrupt/undecodable records are zero-filled with label -1 so the
+      // consumer can mask them out; 0 would silently train as class 0
       if (!ok) {
         std::fill(out, out + per_img, 0.f);
-        std::fill(lab, lab + p->label_width, 0.f);
+        std::fill(lab, lab + p->label_width, -1.f);
         slot->errors++;
         continue;
       }
@@ -362,7 +364,10 @@ void worker_loop(Pipeline* p) {
                            : (l == 0 ? ir.label : 0.f);
       bool dec_ok;
       process_record(ir.img, ir.img_len, p->aug, out, &rng, &dec_ok);
-      if (!dec_ok) slot->errors++;
+      if (!dec_ok) {
+        std::fill(lab, lab + p->label_width, -1.f);
+        slot->errors++;
+      }
     }
     {
       std::lock_guard<std::mutex> lk(p->mu);

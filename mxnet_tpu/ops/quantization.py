@@ -175,7 +175,19 @@ def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
     pads = _tup(pad, n) if pad is not None else (0,) * n
     dims = (1, 1) + tuple(kernel)
     strd = (1, 1) + strides
-    padc = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if pooling_convention == "full":
+        # ceil-mode output size: extend the high-side padding so the last
+        # (partial) window fits — mirrors the float Pooling op, so a
+        # quantize_graph pass-through of pooling_convention keeps shapes
+        padc = [(0, 0), (0, 0)]
+        for i in range(n):
+            span = data.shape[2 + i] + 2 * pads[i]
+            out_sz = -(-(span - kernel[i]) // strides[i]) + 1
+            extra = (out_sz - 1) * strides[i] + kernel[i] - span
+            padc.append((pads[i], pads[i] + max(extra, 0)))
+        padc = tuple(padc)
+    else:
+        padc = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
     if pool_type == "max":
         init = jnp.iinfo(jnp.int8).min if data.dtype == jnp.int8 else 0
         out = lax.reduce_window(data, jnp.asarray(init, data.dtype),

@@ -1,0 +1,86 @@
+"""Flash-attention op + Pallas kernel tests (CPU: interpret mode / jnp
+fallback; the same kernels run compiled on a real TPU — see bench.py's
+attention microbench for the on-chip numbers).
+
+Reference capability: ``src/operator/contrib/transformer.cc``
+(interleaved matmul self-attention pipeline).
+"""
+import numpy as onp
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import pallas_attention as P
+
+
+def _dense(q, k, v, causal=False, scale=None):
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2:]
+        mask = onp.tril(onp.ones((tq, tk), bool), tk - tq)
+        s = jnp.where(jnp.asarray(mask), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def _rand(shape, seed):
+    return jnp.asarray(
+        onp.random.RandomState(seed).uniform(-1, 1, shape).astype("float32"))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 3, 256, 64), (1, 1, 200, 48)])
+def test_pallas_fwd_kernel_matches_dense(causal, shape):
+    q, k, v = (_rand(shape, i) for i in range(3))
+    out, lse = P.pallas_flash_attention(
+        q, k, v, causal=causal, interpret=True, return_lse=True,
+        block_q=128, block_k=128)
+    ref = _dense(q, k, v, causal)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+    # lse really is the softmax log-normalizer
+    want_lse = jax.nn.logsumexp(
+        (jnp.einsum("bhqd,bhkd->bhqk", q, k) * shape[-1] ** -0.5
+         ).astype(jnp.float32), axis=-1)
+    if not causal:
+        assert float(jnp.max(jnp.abs(lse - want_lse))) < 2e-4
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_bwd_kernels_match_dense_vjp(causal):
+    shape = (2, 2, 256, 64)
+    q, k, v = (_rand(shape, 10 + i) for i in range(3))
+    g = _rand(shape, 20)
+    out, lse = P.pallas_flash_attention(
+        q, k, v, causal=causal, interpret=True, return_lse=True,
+        block_q=128, block_k=128)
+    dq, dk, dv = P.pallas_flash_attention_bwd(
+        q, k, v, out, lse, g, causal=causal, interpret=True,
+        block_q=128, block_k=128)
+    _, vjp = jax.vjp(lambda a, b, c: _dense(a, b, c, causal), q, k, v)
+    rq, rk, rv = vjp(g)
+    for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+        assert float(jnp.max(jnp.abs(got - want))) < 5e-5
+
+
+def test_flash_attention_op_and_grad_fallback():
+    """The registered op (jnp fallback off-TPU) forward + custom-vjp grad."""
+    shape = (1, 2, 128, 32)
+    q, k, v = (_rand(shape, 30 + i) for i in range(3))
+    out = mx.nd.flash_attention(mx.nd.from_jax(q), mx.nd.from_jax(k),
+                                mx.nd.from_jax(v))
+    ref = _dense(q, k, v)
+    assert onp.abs(out.asnumpy() - onp.asarray(ref)).max() < 2e-5
+
+    def loss(q, k, v):
+        return jnp.sum(P.flash_attention(q, k, v) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense(q, k, v) ** 2)
+
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for g1, g2 in zip(got, want):
+        assert float(jnp.max(jnp.abs(g1 - g2))) < 5e-5

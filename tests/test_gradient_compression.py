@@ -51,8 +51,14 @@ def test_bad_params_rejected():
         GradientCompression({"type": "2bit", "bogus": 3})
 
 
-def test_kvstore_push_applies_compression():
+def test_kvstore_local_rejects_compression():
     kv = mx.kv.create("local")
+    with pytest.raises(MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+
+def test_kvstore_push_applies_compression():
+    kv = mx.kv.create("device")
     kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
     w = mx.nd.zeros((4,))
     kv.init("w", w)

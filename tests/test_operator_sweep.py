@@ -623,6 +623,18 @@ COVERED_ELSEWHERE = {
     "_random_generalized_negative_binomial",
     "random_negative_binomial", "random_generalized_negative_binomial",
     "multinomial", "shuffle",
+    # tested in tests/test_quantization.py (golden-value checks vs numpy
+    # quantization math and the float ops)
+    "quantize", "_contrib_quantize", "quantize_v2", "_contrib_quantize_v2",
+    "dequantize", "_contrib_dequantize", "requantize", "_contrib_requantize",
+    "quantized_conv", "_contrib_quantized_conv",
+    "quantized_fully_connected", "_contrib_quantized_fully_connected",
+    "quantized_pooling", "_contrib_quantized_pooling",
+    "quantized_flatten", "_contrib_quantized_flatten",
+    "quantized_elemwise_add", "_contrib_quantized_elemwise_add",
+    "quantized_act", "_contrib_quantized_act",
+    # tested in tests/test_flash_attention.py (kernel + op + vjp)
+    "flash_attention", "_contrib_flash_attention",
     # tested in tests/test_gluon_contrib.py (layer-level value checks)
     "_contrib_SyncBatchNorm", "SyncBatchNorm",
     "_contrib_DeformableConvolution", "DeformableConvolution",

@@ -128,3 +128,79 @@ def test_quantize_model_bad_mode(float_model):
         quantize_model(sym, args, {}, calib_mode="bogus")
     with pytest.raises(MXNetError):
         quantize_model(sym, args, {}, calib_mode="naive", calib_data=None)
+
+
+# ---------------------------------------------------------------------------
+# per-op golden tests vs plain numpy quantization math (round-3 coverage for
+# the ops the registry gate flagged)
+# ---------------------------------------------------------------------------
+
+def test_quantize_v1_uint8_and_int8_golden():
+    x = onp.linspace(-2.0, 3.0, 13).astype("float32")
+    # uint8: affine over [min, max]
+    q, mn, mxr = mx.nd.quantize(mx.nd.array(x), mx.nd.array(-2.0),
+                                mx.nd.array(3.0), out_type="uint8")
+    scale = 255.0 / 5.0
+    want = onp.clip(onp.rint((x + 2.0) * scale), 0, 255).astype("uint8")
+    onp.testing.assert_array_equal(q.asnumpy(), want)
+    assert float(mn.asnumpy()) == -2.0 and float(mxr.asnumpy()) == 3.0
+    # int8: symmetric over ±max(|min|,|max|)
+    q8, mn8, mx8 = mx.nd.quantize(mx.nd.array(x), mx.nd.array(-2.0),
+                                  mx.nd.array(3.0), out_type="int8")
+    want8 = onp.clip(onp.rint(x * (127.0 / 3.0)), -127, 127).astype("int8")
+    onp.testing.assert_array_equal(q8.asnumpy(), want8)
+    assert float(mn8.asnumpy()) == -3.0 and float(mx8.asnumpy()) == 3.0
+
+
+def test_requantize_golden():
+    onp.random.seed(0)
+    real = onp.random.uniform(-4, 4, (64,)).astype("float32")
+    unit_range = 6.0  # the int32 data spans ±6.0 in float
+    acc = onp.rint(real / unit_range * (2.0 ** 31 - 1)).astype("int64")
+    q, mn, mxr = mx.nd.requantize(
+        mx.nd.array(acc.astype("int32")), mx.nd.array(-unit_range),
+        mx.nd.array(unit_range))
+    back = mx.nd.dequantize(q, mn, mxr).asnumpy()
+    assert onp.abs(back - real).max() < 4.0 / 127 + 1e-3
+
+
+@pytest.mark.parametrize("conv", ["valid", "full"])
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_quantized_pooling_matches_float(conv, ptype):
+    onp.random.seed(1)
+    x = onp.random.uniform(-1, 1, (2, 3, 7, 7)).astype("float32")
+    qx, mn, mxr = mx.nd.quantize_v2(mx.nd.array(x), out_type="int8")
+    qy, qmn, qmx = mx.nd.quantized_pooling(
+        qx, mn, mxr, kernel=(3, 3), stride=(2, 2), pool_type=ptype,
+        pooling_convention=conv)
+    got = mx.nd.dequantize(qy, qmn, qmx).asnumpy()
+    want = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3), stride=(2, 2),
+                         pool_type=ptype,
+                         pooling_convention=conv).asnumpy()
+    assert got.shape == want.shape, (got.shape, want.shape)
+    assert onp.abs(got - want).max() < 0.05
+
+
+def test_quantized_flatten_and_act():
+    onp.random.seed(2)
+    x = onp.random.uniform(-1, 1, (2, 3, 4)).astype("float32")
+    qx, mn, mxr = mx.nd.quantize_v2(mx.nd.array(x), out_type="int8")
+    f, fmn, fmx = mx.nd.quantized_flatten(qx, mn, mxr)
+    assert f.shape == (2, 12)
+    onp.testing.assert_array_equal(f.asnumpy(),
+                                   qx.asnumpy().reshape(2, 12))
+    r, rmn, rmx = mx.nd.quantized_act(qx, mn, mxr, act_type="relu")
+    got = mx.nd.dequantize(r, rmn, rmx).asnumpy()
+    want = onp.maximum(mx.nd.dequantize(qx, mn, mxr).asnumpy(), 0)
+    assert onp.abs(got - want).max() < 0.02
+
+
+def test_quantized_elemwise_add_matches_float():
+    onp.random.seed(3)
+    a = onp.random.uniform(-1, 1, (32,)).astype("float32")
+    b = onp.random.uniform(-3, 3, (32,)).astype("float32")
+    qa, amn, amx = mx.nd.quantize_v2(mx.nd.array(a), out_type="int8")
+    qb, bmn, bmx = mx.nd.quantize_v2(mx.nd.array(b), out_type="int8")
+    s, smn, smx = mx.nd.quantized_elemwise_add(qa, qb, amn, amx, bmn, bmx)
+    got = mx.nd.dequantize(s, smn, smx).asnumpy()
+    assert onp.abs(got - (a + b)).max() < 0.1
