@@ -25,12 +25,13 @@ if _os.environ.get("MXNET_TPU_COORDINATOR_ADDRESS"):
     # computation initializes the jax backends — by first import is the only
     # reliably-early point, so the library owns this invariant rather than
     # every entry-point script.
-    import jax.distributed as _jdist
-    if not _jdist.is_initialized():
-        _jdist.initialize(
-            coordinator_address=_os.environ["MXNET_TPU_COORDINATOR_ADDRESS"],
-            num_processes=int(_os.environ.get("MXNET_TPU_NUM_PROCESSES", 1)),
-            process_id=int(_os.environ.get("MXNET_TPU_PROCESS_ID", 0)))
+    # deliberately NOT caught: with the distributed env set, proceeding
+    # single-process after a failed join would silently train on 1/N of
+    # the data (the reference's dist kvstore errors hard the same way).
+    # One bootstrap implementation: parallel.initialize (idempotent, reads
+    # the same env contract incl. MXNET_TPU_INIT_TIMEOUT).
+    from .parallel import initialize as _dist_init
+    _dist_init()
 
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
@@ -54,6 +55,7 @@ from . import recordio
 from . import image
 from . import gluon
 from . import parallel
+from . import operator
 from . import profiler
 from . import symbol
 from . import symbol as sym

@@ -103,18 +103,21 @@ class Context:
 
 
 def _cpu_devices():
+    # local (addressable) devices only: in a multi-process job
+    # jax.devices() is the GLOBAL list and placing onto another process's
+    # device is an error
     try:
-        return jax.devices("cpu")
+        return jax.local_devices(backend="cpu")
     except RuntimeError:
         # Some deployments expose only the accelerator backend (no host-CPU
         # platform registered).  cpu() then resolves to the default devices so
         # default-context array creation still works; arrays simply live in
         # HBM, which is semantically fine (XLA owns placement).
-        return jax.devices()
+        return jax.local_devices()
 
 
 def _accel_devices():
-    devs = jax.devices()
+    devs = jax.local_devices()
     non_cpu = [d for d in devs if d.platform != "cpu"]
     return non_cpu if non_cpu else devs
 
@@ -138,7 +141,7 @@ def tpu(device_id: int = 0) -> Context:
 
 def num_gpus() -> int:
     """Number of accelerator devices visible (reference: context.num_gpus)."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return len(devs)
 
 

@@ -254,7 +254,57 @@ class KVStoreTPU(KVStoreLocal):
         # compress (worker-side, reference kvstore_dist.h:361), then
         # all-reduce across the mesh (the server-side dequantized merge)
         from . import parallel
-        return parallel.allreduce(self._compress_grad(key, value))
+        value = self._compress_grad(key, value)
+        if self._needs_cross_process_sum(value):
+            return self._cross_process_sum(value)
+        return parallel.allreduce(value)
+
+    # -- multi-process (DCN) path --------------------------------------
+    @staticmethod
+    def _needs_cross_process_sum(value):
+        """True when each process pushed its own host-local value: with
+        >1 processes, a numpy/host-committed array is this worker's
+        contribution, not a global array that already includes everyone."""
+        import jax
+        if jax.process_count() <= 1:
+            return False
+        raw = value._data if isinstance(value, NDArray) else value
+        sharding = getattr(raw, "sharding", None)
+        if sharding is None:
+            return True         # plain host value
+        # a single-(local-)device array is process-local; an array whose
+        # devices span processes is already global
+        return len(sharding.device_set) <= len(jax.local_devices())
+
+    @staticmethod
+    def _cross_process_sum(value):
+        """Bit-deterministic sum of per-process values: stack every
+        worker's contribution along a 'worker' mesh axis as one global
+        array, then reduce it in ONE jitted program — XLA runs the same
+        reduction order on every host, so all workers see the identical
+        result (the analogue of the reference's server-side aggregate,
+        kvstore_dist.h merge buffers)."""
+        import jax
+        import numpy as onp
+        from .ndarray.ndarray import _wrap
+        raw = value._data if isinstance(value, NDArray) else value
+        host = onp.asarray(raw)
+        reducer, sharding, per_proc = _cross_process_reducer(
+            host.shape, host.dtype.str)
+        # contribution rides local device 0; other local devices carry
+        # zeros, so a plain dtype-preserving sum gives the per-process sum
+        local = onp.concatenate(
+            [host[None]] + [onp.zeros((1,) + host.shape, host.dtype)]
+            * (per_proc - 1)) if per_proc > 1 else host[None]
+        gshape = (jax.process_count() * per_proc,) + host.shape
+        garr = jax.make_array_from_process_local_data(sharding, local,
+                                                      gshape)
+        out = reducer(garr)
+        # the result is replicated: this process's shard IS the full value.
+        # Hand back a local single-device array so downstream device_put /
+        # asnumpy work without multi-process plumbing.
+        local_out = out.addressable_shards[0].data
+        return _wrap(local_out) if isinstance(value, NDArray) else local_out
 
     @property
     def rank(self) -> int:
@@ -271,12 +321,37 @@ class KVStoreTPU(KVStoreLocal):
         waitall()
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _cross_process_reducer(shape, dtype_str):
+    """Cached (mesh, sharding, jitted sum) per value shape/dtype — a fresh
+    jax.jit per push would retrace and recompile every step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    nproc = jax.process_count()
+    per_proc = len(jax.local_devices())
+    devs = onp.array(jax.devices()).reshape(nproc * per_proc)
+    mesh = Mesh(devs, ("worker",))
+    sharding = NamedSharding(mesh, P("worker"))
+    reducer = jax.jit(lambda g: jnp.sum(g, axis=0),
+                      out_shardings=NamedSharding(mesh, P()))
+    return reducer, sharding, per_proc
+
+
 def _maybe_init_distributed():
-    """Best-effort jax.distributed bootstrap from the tools/launch.py env
-    contract (MXNET_TPU_COORDINATOR_ADDRESS etc.) — the role the
-    reference's kvstore_dist plays when DMLC_ROLE is set."""
+    """jax.distributed bootstrap from the tools/launch.py env contract
+    (MXNET_TPU_COORDINATOR_ADDRESS etc.) — the role the reference's
+    kvstore_dist plays when DMLC_ROLE is set.
+
+    When the distributed env IS set but initialization fails, this raises:
+    silently continuing single-process would train on 1/N of the data
+    while claiming dist_sync (the reference's dist kvstore creation errors
+    hard the same way)."""
     import os
-    import warnings
     if "MXNET_TPU_COORDINATOR_ADDRESS" not in os.environ:
         return
     import jax
@@ -285,10 +360,12 @@ def _maybe_init_distributed():
     try:
         from . import parallel
         parallel.initialize()
-    except Exception as e:  # backends may already be initialized
-        warnings.warn(
-            "dist kvstore: jax.distributed.initialize failed (%s); call "
-            "mx.parallel.initialize() before any jax computation" % e)
+    except Exception as e:
+        raise MXNetError(
+            "dist kvstore: jax.distributed.initialize failed (%s) although "
+            "MXNET_TPU_COORDINATOR_ADDRESS is set; call "
+            "mx.parallel.initialize() before any jax computation, or unset "
+            "the distributed environment" % e)
 
 
 def create(name="local") -> KVStore:

@@ -79,6 +79,13 @@ def _make_op_func(op):
                 return op.fn(key, *full_args, **kw)
             return op.fn(*full_args, **kw)
 
+        factory = getattr(op.fn, "_host_vjp_factory", None)
+        if factory is not None:
+            static_kwargs = {k: v for k, v in kwargs.items()
+                             if k not in kw_keys}
+            hook = factory(static_kwargs)
+            if hook is not None:   # only on callback-less backends
+                fn._host_vjp = hook
         return invoke_fn(fn, arrays, name=op.name, out=out,
                          n_outputs=op.num_outputs, ctx=ctx,
                          record=op.differentiable)

@@ -47,7 +47,8 @@ __all__ = [
 ]
 
 
-def initialize(coordinator_address=None, num_processes=None, process_id=None):
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               initialization_timeout=None):
     """Multi-host bootstrap (reference: ps-lite scheduler roles via
     DMLC_PS_ROOT_URI etc., docs/faq/distributed_training.md:254; here the
     jax coordination service).
@@ -56,6 +57,9 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     (MXNET_TPU_COORDINATOR_ADDRESS / _NUM_PROCESSES / _PROCESS_ID), the
     role the reference's DMLC_* env played."""
     import os
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return  # idempotent: mxnet_tpu auto-joins at import when the
+                # launcher env is set (see mxnet_tpu/__init__.py)
     if coordinator_address is None:
         coordinator_address = os.environ.get(
             "MXNET_TPU_COORDINATOR_ADDRESS")
@@ -63,7 +67,11 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
         num_processes = int(os.environ["MXNET_TPU_NUM_PROCESSES"])
     if process_id is None and "MXNET_TPU_PROCESS_ID" in os.environ:
         process_id = int(os.environ["MXNET_TPU_PROCESS_ID"])
+    if initialization_timeout is None and "MXNET_TPU_INIT_TIMEOUT" in os.environ:
+        initialization_timeout = int(os.environ["MXNET_TPU_INIT_TIMEOUT"])
     kw = {}
+    if initialization_timeout is not None:
+        kw["initialization_timeout"] = initialization_timeout
     if coordinator_address is not None:
         kw["coordinator_address"] = coordinator_address
     if num_processes is not None:

@@ -24,7 +24,10 @@ __all__ = ["seed", "next_key", "key_supply", "current_key_supplier"]
 
 class _RngState(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        # lazy: materializing a PRNGKey initialises the XLA backend, which
+        # must not happen at import time (it would break
+        # jax.distributed.initialize for multi-process jobs)
+        self.key: Optional[jax.Array] = None
         self.suppliers: List[Callable[[], jax.Array]] = []
 
 
@@ -41,6 +44,8 @@ def next_key() -> jax.Array:
     """Return a fresh PRNG key, advancing the state."""
     if _STATE.suppliers:
         return _STATE.suppliers[-1]()
+    if _STATE.key is None:
+        _STATE.key = jax.random.PRNGKey(0)
     _STATE.key, sub = jax.random.split(_STATE.key)
     return sub
 

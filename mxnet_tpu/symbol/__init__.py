@@ -198,6 +198,15 @@ def stack(*args, axis: int = 0, name=None, **kwargs):
     return _invoke_op("stack", list(args), {"axis": axis}, name=name)
 
 
+def Custom(*args, op_type: str = "", name=None, **kwargs):
+    """Python CustomOp node (reference src/operator/custom/custom.cc —
+    mx.sym.Custom(data..., op_type='registered_name')).  Variadic: the
+    registered CustomOpProp's list_arguments defines the input count."""
+    attrs = {"op_type": op_type}
+    attrs.update(kwargs)
+    return _invoke_op("Custom", list(args), attrs, name=name)
+
+
 def __getattr__(name):
     op = get_op(name)
     if op is None:

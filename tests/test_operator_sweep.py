@@ -635,6 +635,8 @@ COVERED_ELSEWHERE = {
     "quantized_act", "_contrib_quantized_act",
     # tested in tests/test_flash_attention.py (kernel + op + vjp)
     "flash_attention", "_contrib_flash_attention",
+    # tested in tests/test_custom_op.py (imperative/gluon/module paths)
+    "Custom", "custom",
     # tested in tests/test_gluon_contrib.py (layer-level value checks)
     "_contrib_SyncBatchNorm", "SyncBatchNorm",
     "_contrib_DeformableConvolution", "DeformableConvolution",
