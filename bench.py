@@ -206,6 +206,53 @@ def bench_lstm_lm(batch_size=32, bptt=35, hidden=650, layers=2,
             "loss": round(_sync(loss), 3)}
 
 
+def bench_bert(batch_size=8, seq_len=512, dtype="bfloat16", iters=10,
+               arch="base"):
+    """BERT pretraining-style train step (BASELINE.json config 5): MLM loss
+    over a bert_base encoder whose attention runs in the Pallas flash
+    kernel; fwd+loss+bwd+Adam as one donated XLA program."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import bert_base, bert_small
+
+    vocab = 30522
+    ctor = bert_base if arch == "base" else bert_small
+    net = ctor(vocab_size=vocab, max_length=seq_len, dropout=0.0,
+               use_pooler=False, use_decoder=True)
+    net.initialize(mx.init.Xavier())
+    rs = onp.random.RandomState(0)
+    host_tokens = mx.nd.array(rs.randint(0, vocab, (batch_size, seq_len))
+                              .astype("float32"))
+    net(host_tokens)  # materialize deferred shapes
+    if dtype != "float32":
+        net.cast(dtype)
+    net.collect_params().reset_ctx(mx.tpu())
+    tokens = mx.nd.array(host_tokens.asnumpy(), ctx=mx.tpu())
+    labels = mx.nd.array(rs.randint(0, vocab, (batch_size, seq_len))
+                         .astype("float32"), ctx=mx.tpu())
+
+    class MLMLoss(gluon.loss.Loss):
+        def __init__(self):
+            super().__init__(weight=None, batch_axis=0)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, outputs, lab):
+            _, logits = outputs
+            return self._ce(logits.reshape(-1, vocab), lab.reshape(-1))
+
+    step = mx.parallel.DataParallelStep(
+        net, MLMLoss(), mx.optimizer.Adam(learning_rate=1e-4), mesh=None)
+    # the first few calls recompile as donation settles buffer layouts
+    step_s, loss = _time_calls(lambda: step(tokens, labels), _sync,
+                               warmup=4, iters=iters)
+    return {"bench": "bert_mlm_train", "arch": arch,
+            "batch_size": batch_size, "seq_len": seq_len, "dtype": dtype,
+            "step_ms": round(step_s * 1000, 2),
+            "tokens_per_sec": round(batch_size * seq_len / step_s, 1),
+            "loss": round(_sync(loss), 3)}
+
+
 def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
                     inner=10, dtype="bfloat16"):
     """Flash-attention (Pallas TPU kernel) vs dense jnp attention, fwd+bwd.
@@ -311,7 +358,8 @@ def main():
                 args.model, 128, dt, iters=args.iters))
         jobs.append(lambda: bench_lstm_lm(iters=args.iters))
         jobs.append(lambda: bench_lstm_lm(dtype="bfloat16", iters=args.iters))
-        jobs.append(lambda: bench_attention(iters=args.iters))
+        jobs.append(lambda: bench_attention(iters=max(1, args.iters // 4)))
+        jobs.append(lambda: bench_bert(iters=args.iters))
     else:
         jobs.append(lambda: bench_train(args.model, args.batch_size,
                                         "float32", iters=args.iters))

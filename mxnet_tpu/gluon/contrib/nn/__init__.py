@@ -1,4 +1,8 @@
 """Contrib neural-network layers (reference
 ``python/mxnet/gluon/contrib/nn/``)."""
 from .basic_layers import *  # noqa: F401,F403
-from .basic_layers import __all__  # noqa: F401
+from .basic_layers import __all__ as _basic_all
+from .transformer import *  # noqa: F401,F403
+from .transformer import __all__ as _transformer_all
+
+__all__ = list(_basic_all) + list(_transformer_all)

@@ -1,0 +1,143 @@
+"""Transformer building blocks wired to the fused flash-attention kernel.
+
+Capability target: the attention stack BASELINE.json config 5 (BERT-base
+pretraining) needs — the reference's building blocks are the
+``_contrib_interleaved_matmul_selfatt_*`` /``_contrib_div_sqrt_dim`` ops
+(``src/operator/contrib/transformer.cc``) composed by GluonNLP; here the
+hot path is ONE op, ``_contrib_flash_attention`` (Pallas TPU kernel with
+fwd+bwd, ``ops/pallas_attention.py``), and the interleaved ops are also
+provided for ported code (``ops/contrib_ops.py``).
+
+Layers are batch-major (batch, seq, units), Gluon convention.
+Attention-probability dropout is applied to the attention *output* when
+the flash path is active (the fused kernel never materializes the
+probability matrix — the approximation every flash implementation makes).
+An explicit additive ``mask`` forces the dense path, since the kernel
+supports only causal/none masking.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import Dense, Dropout, LayerNorm
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderCell", "TransformerEncoder"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self-attention with a fused qkv projection.
+
+    softmax(q·kᵀ/√d [+ mask])·v over ``num_heads`` heads.  The score/
+    softmax/value contraction runs in the Pallas flash kernel on TPU
+    (jnp blockwise elsewhere); with an additive mask it falls back to the
+    explicit dense composition (equivalent to the reference's
+    interleaved_matmul_selfatt_qk → softmax → valatt pipeline).
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 use_bias=True, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError("units %d not divisible by num_heads %d"
+                             % (units, num_heads))
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, use_bias=use_bias,
+                             prefix="qkv_")
+            self.proj = Dense(units, flatten=False, use_bias=use_bias,
+                              prefix="out_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def _heads_split(self, x):
+        # (B, L, H*D) -> (B, H, L, D)
+        b, l = x.shape[0], x.shape[1]
+        d = self._units // self._heads
+        return x.reshape(b, l, self._heads, d).transpose(axes=(0, 2, 1, 3))
+
+    def hybrid_forward(self, F, x, mask=None):
+        b, l = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)                          # (B, L, 3E)
+        q, k, v = (self._heads_split(part)
+                   for part in F.split(qkv, num_outputs=3, axis=-1))
+        if mask is None:
+            out = F.flash_attention(q, k, v, causal=self._causal)
+        else:
+            d = self._units // self._heads
+            scores = F.batch_dot(q.reshape(-1, l, d),
+                                 k.reshape(-1, l, d),
+                                 transpose_b=True) / (d ** 0.5)
+            scores = scores.reshape(b, self._heads, l, l) + mask
+            probs = F.softmax(scores, axis=-1)
+            out = F.batch_dot(probs.reshape(-1, l, l), v.reshape(-1, l, d))
+            out = out.reshape(b, self._heads, l, d)
+        out = out.transpose(axes=(0, 2, 1, 3)).reshape(b, l, self._units)
+        out = self.proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """The transformer MLP: Dense→activation→Dense (+dropout)."""
+
+    def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.expand = Dense(hidden_size, flatten=False,
+                                activation=activation, prefix="fc1_")
+            self.contract = Dense(units, flatten=False, prefix="fc2_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.contract(self.expand(x))
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN (BERT-style) encoder layer:
+    x → x+MHA(x) → LN → +FFN → LN."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 causal=False, layer_norm_eps=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout,
+                                                causal=causal,
+                                                prefix="attn_")
+            self.attn_norm = LayerNorm(epsilon=layer_norm_eps,
+                                       prefix="attn_ln_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                       prefix="ffn_")
+            self.ffn_norm = LayerNorm(epsilon=layer_norm_eps,
+                                      prefix="ffn_ln_")
+
+    def hybrid_forward(self, F, x, mask=None):
+        x = self.attn_norm(x + self.attention(x, mask))
+        return self.ffn_norm(x + self.ffn(x))
+
+
+class TransformerEncoder(HybridBlock):
+    """A stack of ``num_layers`` encoder cells."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, causal=False, **kwargs):
+        super().__init__(**kwargs)
+        self.cells = []
+        with self.name_scope():
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout=dropout,
+                    causal=causal, prefix="layer%d_" % i)
+                self.register_child(cell)
+                self.cells.append(cell)
+
+    def hybrid_forward(self, F, x, mask=None):
+        for cell in self.cells:
+            x = cell(x, mask)
+        return x

@@ -6,5 +6,7 @@ this build (zero-egress environment); constructors accept ``pretrained``
 for API parity and raise with a clear message when it is requested.
 """
 from . import vision  # noqa: F401
+from . import bert  # noqa: F401
+from .bert import BERTModel, bert_base, bert_small  # noqa: F401
 
-__all__ = ["vision"]
+__all__ = ["vision", "bert", "BERTModel", "bert_base", "bert_small"]

@@ -28,3 +28,16 @@ def isinf(data):
     import jax.numpy as jnp
     return invoke_fn(lambda x: jnp.isinf(x).astype("float32"), [data],
                      name="isinf", record=False)
+
+
+def __getattr__(name):
+    """Forward ``mx.nd.contrib.<op>`` to the registry's ``_contrib_<op>``
+    (or bare-alias) entry — the reference's contrib namespace codegen."""
+    from . import __getattr__ as _nd_getattr
+    for candidate in ("_contrib_" + name, name):
+        try:
+            return _nd_getattr(candidate)
+        except AttributeError:
+            continue
+    raise AttributeError("module 'ndarray.contrib' has no attribute %r"
+                         % name)

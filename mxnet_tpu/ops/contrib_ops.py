@@ -15,13 +15,6 @@ from jax import lax
 from .registry import register
 
 
-@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
-def div_sqrt_dim(data):
-    """out = data / sqrt(data.shape[-1]) (reference
-    src/operator/contrib/transformer.cc:34 — attention-score rescale)."""
-    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
-
-
 @register("_contrib_quadratic", aliases=("quadratic",))
 def quadratic(data, a: float = 0.0, b: float = 0.0, c: float = 0.0):
     """out = a*x^2 + b*x + c (reference
@@ -119,3 +112,89 @@ def multi_all_finite(*arrays, num_arrays: int = 1, init_output: bool = True):
     for a in arrays:
         ok = ok & jnp.isfinite(a).all()
     return ok.astype(jnp.float32).reshape(1)
+
+
+# ---------------------------------------------------------------------------
+# transformer ops (reference src/operator/contrib/transformer.cc has
+# _contrib_div_sqrt_dim in this snapshot; the interleaved_matmul family is
+# the same file's later extension used by BERT-style models — implemented
+# here with its documented layouts so attention code ports unchanged)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    """out = data / sqrt(data.shape[-1]) (reference transformer.cc:34)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], jnp.float32)).astype(
+        data.dtype)
+
+
+def _split_interleaved(qkv, heads, parts):
+    """(L, B, H*parts*D) interleaved per head → ``parts`` tensors shaped
+    (B*H, L, D) ready for batched attention matmuls."""
+    L, B, F = qkv.shape
+    D = F // (heads * parts)
+    x = qkv.reshape(L, B, heads, parts, D)
+    x = jnp.transpose(x, (3, 1, 2, 0, 4))        # (parts, B, H, L, D)
+    x = x.reshape(parts, B * heads, L, D)
+    return tuple(x[i] for i in range(parts))
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk",
+          aliases=("interleaved_matmul_selfatt_qk",))
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads: int = 1):
+    """Scores q·kᵀ/√D from one interleaved qkv projection.
+
+    Input (qlen, batch, 3*H*D) with per-head [q,k,v] interleaving — the
+    layout one fused Dense(3*E) projection produces; output
+    (batch*H, qlen, qlen).  On TPU the reshapes are free relayouts and the
+    matmul hits the MXU as one batched dot.
+    """
+    q, k, _ = _split_interleaved(queries_keys_values, heads, 3)
+    scale = (1.0 / (q.shape[-1] ** 0.5))
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    return s.astype(queries_keys_values.dtype)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt",
+          aliases=("interleaved_matmul_selfatt_valatt",))
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads: int = 1):
+    """att·v back to (qlen, batch, H*D) from the interleaved qkv input."""
+    _, _, v = _split_interleaved(queries_keys_values, heads, 3)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v,
+                     preferred_element_type=jnp.float32)
+    B_H, L, D = out.shape
+    B = B_H // heads
+    out = out.reshape(B, heads, L, D)
+    out = jnp.transpose(out, (2, 0, 1, 3)).reshape(L, B, heads * D)
+    return out.astype(queries_keys_values.dtype)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk",
+          aliases=("interleaved_matmul_encdec_qk",))
+def interleaved_matmul_encdec_qk(queries, keys_values, heads: int = 1):
+    """Cross-attention scores: q (qlen,B,H*D) vs interleaved kv
+    (klen,B,2*H*D) → (B*H, qlen, klen), scaled by 1/√D."""
+    Lq, B, F = queries.shape
+    D = F // heads
+    q = jnp.transpose(queries.reshape(Lq, B, heads, D),
+                      (1, 2, 0, 3)).reshape(B * heads, Lq, D)
+    k, _ = _split_interleaved(keys_values, heads, 2)
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * (1.0 / D ** 0.5)
+    return s.astype(queries.dtype)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt",
+          aliases=("interleaved_matmul_encdec_valatt",))
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads: int = 1):
+    """Cross-attention att·v → (qlen, batch, H*D)."""
+    _, v = _split_interleaved(keys_values, heads, 2)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v,
+                     preferred_element_type=jnp.float32)
+    B_H, Lq, D = out.shape
+    B = B_H // heads
+    out = out.reshape(B, heads, Lq, D)
+    out = jnp.transpose(out, (2, 0, 1, 3)).reshape(Lq, B, heads * D)
+    return out.astype(keys_values.dtype)

@@ -356,6 +356,10 @@ def activation(data, act_type: str = "relu"):
         return jax.nn.softplus(data)
     if act_type == "softsign":
         return jax.nn.soft_sign(data)
+    if act_type == "gelu":
+        # the reference exposes gelu via LeakyReLU(act_type='gelu'); also
+        # accepted here so Dense(activation='gelu') composes directly
+        return jax.nn.gelu(data, approximate=False)
     raise ValueError("unknown act_type %r" % act_type)
 
 

@@ -637,6 +637,16 @@ COVERED_ELSEWHERE = {
     "flash_attention", "_contrib_flash_attention",
     # tested in tests/test_custom_op.py (imperative/gluon/module paths)
     "Custom", "custom",
+    # tested in tests/test_transformer.py (numpy-oracle value checks)
+    "_contrib_div_sqrt_dim", "div_sqrt_dim",
+    "_contrib_interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_selfatt_valatt",
+    "_contrib_interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_qk",
+    "_contrib_interleaved_matmul_encdec_valatt",
+    "interleaved_matmul_encdec_valatt",
     # tested in tests/test_gluon_contrib.py (layer-level value checks)
     "_contrib_SyncBatchNorm", "SyncBatchNorm",
     "_contrib_DeformableConvolution", "DeformableConvolution",

@@ -1,0 +1,162 @@
+"""Transformer ops + layers + BERT (reference capability:
+src/operator/contrib/transformer.cc and the GluonNLP BERT stack built on
+it).  Oracles: hand-rolled numpy/torch attention."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.contrib.nn import (MultiHeadAttention,
+                                        TransformerEncoder,
+                                        TransformerEncoderCell)
+from mxnet_tpu.gluon.model_zoo import bert_small
+
+
+def _np_attention(q, k, v):
+    d = q.shape[-1]
+    s = onp.einsum("bhqd,bhkd->bhqk", q, k) / onp.sqrt(d)
+    s = s - s.max(-1, keepdims=True)
+    p = onp.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    return onp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_div_sqrt_dim():
+    x = onp.random.RandomState(0).randn(3, 8).astype("float32")
+    out = mx.nd.contrib.div_sqrt_dim(mx.nd.array(x))
+    onp.testing.assert_allclose(out.asnumpy(), x / onp.sqrt(8.0), rtol=1e-6)
+
+
+def test_interleaved_selfatt_matches_dense():
+    """qk + softmax + valatt == plain attention on de-interleaved q/k/v."""
+    rs = onp.random.RandomState(1)
+    L, B, H, D = 12, 2, 3, 8
+    qkv = rs.randn(L, B, H * 3 * D).astype("float32")
+    s = mx.nd.contrib.interleaved_matmul_selfatt_qk(
+        mx.nd.array(qkv), heads=H)
+    assert s.shape == (B * H, L, L)
+    att = mx.nd.softmax(s, axis=-1)
+    out = mx.nd.contrib.interleaved_matmul_selfatt_valatt(
+        mx.nd.array(qkv), att, heads=H)
+    assert out.shape == (L, B, H * D)
+
+    x = qkv.reshape(L, B, H, 3, D)
+    q = onp.transpose(x[:, :, :, 0], (1, 2, 0, 3))  # (B,H,L,D)
+    k = onp.transpose(x[:, :, :, 1], (1, 2, 0, 3))
+    v = onp.transpose(x[:, :, :, 2], (1, 2, 0, 3))
+    want = _np_attention(q, k, v)                    # (B,H,L,D)
+    want = onp.transpose(want, (2, 0, 1, 3)).reshape(L, B, H * D)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_encdec_matches_dense():
+    rs = onp.random.RandomState(2)
+    Lq, Lk, B, H, D = 6, 9, 2, 2, 4
+    q_in = rs.randn(Lq, B, H * D).astype("float32")
+    kv = rs.randn(Lk, B, H * 2 * D).astype("float32")
+    s = mx.nd.contrib.interleaved_matmul_encdec_qk(
+        mx.nd.array(q_in), mx.nd.array(kv), heads=H)
+    assert s.shape == (B * H, Lq, Lk)
+    att = mx.nd.softmax(s, axis=-1)
+    out = mx.nd.contrib.interleaved_matmul_encdec_valatt(
+        mx.nd.array(kv), att, heads=H)
+    q = onp.transpose(q_in.reshape(Lq, B, H, D), (1, 2, 0, 3))
+    x = kv.reshape(Lk, B, H, 2, D)
+    k = onp.transpose(x[:, :, :, 0], (1, 2, 0, 3))
+    v = onp.transpose(x[:, :, :, 1], (1, 2, 0, 3))
+    want = _np_attention(q, k, v)
+    want = onp.transpose(want, (2, 0, 1, 3)).reshape(Lq, B, H * D)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_mha_matches_torch_oracle():
+    """MultiHeadAttention forward == torch.nn.MultiheadAttention with the
+    same weights."""
+    torch = pytest.importorskip("torch")
+    rs = onp.random.RandomState(3)
+    B, L, E, H = 2, 10, 32, 4
+    x = rs.randn(B, L, E).astype("float32")
+
+    mha = MultiHeadAttention(E, H, use_bias=True)
+    mha.initialize()
+    _ = mha(mx.nd.array(x))  # materialize shapes
+
+    tm = torch.nn.MultiheadAttention(E, H, bias=True, batch_first=True)
+    p = mha.collect_params()
+    qkv_w = [v for k, v in p.items() if k.endswith("qkv_weight")][0]
+    qkv_b = [v for k, v in p.items() if k.endswith("qkv_bias")][0]
+    out_w = [v for k, v in p.items() if k.endswith("out_weight")][0]
+    out_b = [v for k, v in p.items() if k.endswith("out_bias")][0]
+    with torch.no_grad():
+        tm.in_proj_weight.copy_(torch.tensor(qkv_w.data().asnumpy()))
+        tm.in_proj_bias.copy_(torch.tensor(qkv_b.data().asnumpy()))
+        tm.out_proj.weight.copy_(torch.tensor(out_w.data().asnumpy()))
+        tm.out_proj.bias.copy_(torch.tensor(out_b.data().asnumpy()))
+        want, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                     need_weights=False)
+    got = mha(mx.nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_mha_masked_path_matches_flash_path():
+    """A zero additive mask (dense path) must equal the flash path."""
+    rs = onp.random.RandomState(4)
+    B, L, E, H = 2, 16, 24, 3
+    x = mx.nd.array(rs.randn(B, L, E).astype("float32"))
+    mha = MultiHeadAttention(E, H)
+    mha.initialize()
+    flash = mha(x).asnumpy()
+    dense = mha(x, mx.nd.zeros((B, H, L, L))).asnumpy()
+    onp.testing.assert_allclose(flash, dense, rtol=1e-4, atol=1e-5)
+
+
+def test_encoder_cell_grads_flow():
+    cell = TransformerEncoderCell(32, 64, 4)
+    cell.initialize()
+    x = mx.nd.array(onp.random.RandomState(5).randn(2, 8, 32)
+                    .astype("float32"))
+    params = cell.collect_params()
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.0})
+    with autograd.record():
+        y = cell(x)
+        loss = (y * y).mean()
+    loss.backward()
+    grads = [v.grad().asnumpy() for _, v in sorted(params.items())
+             if v.grad_req != "null"]
+    assert grads and all(onp.isfinite(g).all() for g in grads)
+    assert any(onp.abs(g).max() > 0 for g in grads)
+
+
+def test_bert_small_trains():
+    """MLM-style loss on bert_small descends under DataParallelStep."""
+    rs = onp.random.RandomState(6)
+    net = bert_small(vocab_size=500, max_length=64, dropout=0.0,
+                     use_pooler=False, use_decoder=True)
+    net.initialize(mx.init.Xavier())
+    B, L = 4, 16
+    tokens = mx.nd.array(rs.randint(0, 500, (B, L)).astype("float32"))
+    _ = net(tokens)  # materialize
+
+    class MLMLoss(gluon.loss.Loss):
+        def __init__(self):
+            super().__init__(weight=None, batch_axis=0)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, outputs, labels):
+            seq, logits = outputs
+            return self._ce(logits.reshape(-1, 500), labels.reshape(-1))
+
+    class Wrap(gluon.HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def hybrid_forward(self, F, tokens):
+            return self.inner(tokens)
+
+    step = mx.parallel.DataParallelStep(
+        net, MLMLoss(), mx.optimizer.Adam(learning_rate=3e-3), mesh=None)
+    labels = mx.nd.array(rs.randint(0, 500, (B, L)).astype("float32"))
+    losses = [float(step(tokens, labels).asnumpy())
+              for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.8, losses
