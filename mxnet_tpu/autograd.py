@@ -225,9 +225,25 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         lst[idx] = value if lst[idx] is None else lst[idx] + value
 
     def _acc_var(ag, value):
+        from .ndarray.sparse import RowSparseNDArray as _RS, \
+            merge_row_sparse as _merge
         k = id(ag)
         var_ag[k] = ag
-        var_acc[k] = value if k not in var_acc else var_acc[k] + value
+        if k not in var_acc:
+            var_acc[k] = value
+            return
+        prev = var_acc[k]
+        prev_sp = isinstance(prev, _RS) and prev.has_parts
+        val_sp = isinstance(value, _RS) and value.has_parts
+        if prev_sp and val_sp:
+            var_acc[k] = _merge(prev, value)
+        elif prev_sp or val_sp:
+            # mixed sparse+dense: correctness first — densify
+            pd = prev._data if isinstance(prev, NDArray) else prev
+            vd = value._data if isinstance(value, NDArray) else value
+            var_acc[k] = pd + vd
+        else:
+            var_acc[k] = prev + value
 
     for h, hg, ag in zip(heads, head_grads, heads_ag):
         if hg is not None:
@@ -249,8 +265,14 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         if outs_ct is None:
             continue
         host_vjp = getattr(node.fn, "_host_vjp", None)
+        sparse_vjp = getattr(node.fn, "_sparse_vjp", None)
         if create_graph:
             in_grads = _vjp_recorded(node, outs_ct)
+        elif sparse_vjp is not None:
+            # sparse-gradient op (Embedding(sparse_grad=True)): the weight
+            # gradient comes back as a parts-backed RowSparseNDArray whose
+            # size scales with the batch's live rows, not the table
+            in_grads = sparse_vjp(node.in_values, outs_ct)
         elif host_vjp is not None:
             # host-computed op (CustomOp on a backend without host-callback
             # support): gradient runs on concrete values outside any trace
@@ -267,9 +289,18 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         for ag, g in zip(node.in_ag, in_grads):
             if ag is None or g is None:
                 continue
-            # keep NDArrays (with tape links) when building a grad-of-grad graph
-            gval = g if (create_graph and isinstance(g, NDArray)) else (
-                g._data if isinstance(g, NDArray) else g)
+            from .ndarray.sparse import RowSparseNDArray as _RS
+            if isinstance(g, _RS) and g.has_parts and ag.node is None:
+                # stays sparse through accumulation — leaves only: a
+                # cotangent routed into another recorded node must be a
+                # plain array for that node's jax.vjp
+                gval = g
+            elif isinstance(g, _RS) and g.has_parts:
+                gval = g._data  # non-leaf target: densify
+            else:
+                # keep NDArrays (with tape links) for grad-of-grad graphs
+                gval = g if (create_graph and isinstance(g, NDArray)) else (
+                    g._data if isinstance(g, NDArray) else g)
             if ag.node is None:  # variable leaf
                 if ag.grad_req == "null":
                     continue
@@ -278,10 +309,20 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
                 _acc_slot(cotan, id(ag.node), ag.index, ag.node.n_outputs, gval)
 
     # write/add into grad buffers
+    from .ndarray.sparse import RowSparseNDArray as _RSW, \
+        make_row_sparse_inplace as _mk_rs
     for k, ag in var_ag.items():
         if ag.grad is None:
             continue
         accum = var_acc[k]
+        if isinstance(accum, _RSW) and accum.has_parts:
+            if ag.grad_req == "add":
+                # accumulate-into-buffer requires dense arithmetic
+                ag.grad._data = ag.grad._data + accum._data
+            else:
+                _mk_rs(ag.grad, accum.__dict__["_sp_values"],
+                       accum.__dict__["_sp_indices"], accum.shape)
+            continue
         if isinstance(accum, NDArray):
             # create_graph: transfer both value and tape link so the grad
             # buffer itself is differentiable (higher-order autograd)

@@ -59,8 +59,15 @@ class _FusedUpdate:
             return False
         import jax
         import jax.numpy as jnp
+        from ..ndarray.sparse import RowSparseNDArray
         optimizer = self._updater.optimizer
         if optimizer.multi_precision:
+            return False
+        if any(isinstance(g, RowSparseNDArray) and g.has_parts
+               for g in grads):
+            # parts-backed sparse grads must reach the optimizer's lazy
+            # row-sparse branch; the fused dense step would densify them
+            # (and decay momentum on every row)
             return False
         states = self._updater.states
         for i, w in zip(indices, weights):

@@ -64,16 +64,14 @@ class KVStore:
             self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the requested rows (reference kvstore.py:268).
-
-        Storage is dense on TPU (ndarray/sparse.py facade), but the
-        *contract* is honored: with ``row_ids`` given, rows outside the
-        request come back zero, exactly like the reference's row_sparse
-        pull — not a silent dense pull."""
+        """Pull ONLY the requested rows (reference kvstore.py:268 /
+        kvstore_dist.h PullRowSparse): the out array becomes a parts-backed
+        RowSparseNDArray holding just the gathered rows — pull cost and
+        delivered memory scale with len(row_ids), not the table."""
         if row_ids is None:
             return self.pull(key, out=out, priority=priority)
+        import numpy as onp
         from .ndarray import sparse as _sparse
-        self.pull(key, out=out, priority=priority)
         outs = out if isinstance(out, (list, tuple)) else [out]
         rids = row_ids if isinstance(row_ids, (list, tuple)) \
             else [row_ids] * len(outs)
@@ -81,10 +79,17 @@ class KVStore:
             raise MXNetError(
                 "row_sparse_pull: len(row_ids)=%d must match len(out)=%d"
                 % (len(rids), len(outs)))
-        for o, rid in zip(outs, rids):
-            kept = _sparse.retain(
-                _sparse.cast_storage(o, "row_sparse"), rid)
-            o._data = kept._data
+        keys = key if isinstance(key, (list, tuple)) else [key] * len(outs)
+        for k, o, rid in zip(keys, outs, rids):
+            stored = self._stored_value(k)
+            idx = onp.unique(onp.asarray(
+                rid.asnumpy() if isinstance(rid, NDArray) else rid)
+                .astype(onp.int64))
+            # absent rows are zero in row_sparse semantics: drop ids
+            # outside the table instead of letting the gather clamp
+            idx = idx[(idx >= 0) & (idx < stored.shape[0])]
+            rows = stored._data[idx]           # one gather, ∝ len(idx)
+            _sparse.make_row_sparse_inplace(o, rows, idx, stored.shape)
         return out
 
     def broadcast(self, key, value, out, priority=0):
@@ -184,6 +189,11 @@ class KVStoreLocal(KVStore):
         super().__init__()
         self._type = type_str
         self._store: Dict = {}
+
+    def _stored_value(self, key):
+        if key not in self._store:
+            raise MXNetError("key %r has not been init'd" % (key,))
+        return self._store[key]
 
     def init(self, key, value):
         keys = _as_list(key)

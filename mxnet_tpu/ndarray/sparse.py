@@ -53,20 +53,88 @@ class BaseSparseNDArray(NDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Row-sparse: a subset of rows is non-zero (reference
-    sparse.py:560).  ``indices`` — sorted non-zero row ids; ``data`` —
-    the dense values of those rows."""
+    """Row-sparse: a subset of rows is non-zero (reference sparse.py:560).
+    ``indices`` — sorted non-zero row ids; ``data`` — the dense values of
+    those rows.
+
+    Two storage modes:
+
+    * **parts-backed** (``from_parts`` / ``row_sparse_array((data, idx))``):
+      only (values, indices) are stored — memory and update cost scale
+      with the number of live rows, which is the point of row_sparse
+      (large-vocab embedding gradients).  The dense view is materialized
+      lazily ONLY if a dense consumer touches ``_data``.
+    * **facade** (constructed from a dense source): dense storage with the
+      sparse accessors computed on demand — API-parity mode.
+    """
 
     _stype = "row_sparse"
 
+    @classmethod
+    def from_parts(cls, values, indices, shape, ctx=None) ->             "RowSparseNDArray":
+        """Build parts-backed: values (nnz, *row_shape), indices (nnz,)
+        unique row ids; nothing is densified."""
+        from .ndarray import _ctx_of
+        obj = cls.__new__(cls)
+        obj.__dict__["_ctx"] = _ctx_of(ctx)
+        obj.__dict__["_ag"] = None
+        obj.__dict__["_dense_cache"] = None
+        obj.__dict__["_sp_values"] = jnp.asarray(
+            values._data if isinstance(values, NDArray) else values)
+        obj.__dict__["_sp_indices"] = jnp.asarray(
+            indices._data if isinstance(indices, NDArray) else indices,
+            jnp.int32)
+        obj.__dict__["_sp_shape"] = tuple(int(s) for s in shape)
+        return obj
+
+    @property
+    def has_parts(self) -> bool:
+        return self.__dict__.get("_sp_values") is not None
+
+    # _data is a property so parts-backed arrays densify only on demand;
+    # any dense write (ops, zero_grad) drops the parts
+    @property
+    def _data(self):
+        cache = self.__dict__.get("_dense_cache")
+        if cache is None:
+            vals = self.__dict__.get("_sp_values")
+            if vals is None:
+                raise MXNetError("empty RowSparseNDArray")
+            cache = jnp.zeros(self.__dict__["_sp_shape"], vals.dtype).at[
+                self.__dict__["_sp_indices"]].set(vals)
+            self.__dict__["_dense_cache"] = cache
+        return cache
+
+    @_data.setter
+    def _data(self, v):
+        self.__dict__["_dense_cache"] = v
+        self.__dict__["_sp_values"] = None
+        self.__dict__["_sp_indices"] = None
+
+    @property
+    def shape(self):
+        if self.has_parts:
+            return self.__dict__["_sp_shape"]
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        if self.has_parts:
+            return onp.dtype(self.__dict__["_sp_values"].dtype)
+        return onp.dtype(self._data.dtype)
+
     @property
     def indices(self) -> NDArray:
+        if self.has_parts:
+            return _wrap(self.__dict__["_sp_indices"], self._ctx)
         flat = onp.asarray(self.asnumpy()).reshape(self.shape[0], -1)
         nz = onp.nonzero(onp.any(flat != 0, axis=1))[0]
         return _dense_array(nz.astype(onp.int32), ctx=self._ctx)
 
     @property
     def data(self) -> NDArray:
+        if self.has_parts:
+            return _wrap(self.__dict__["_sp_values"], self._ctx)
         idx = onp.asarray(self.indices.asnumpy(), dtype=onp.int32)
         return _wrap(self._data[idx], self._ctx)
 
@@ -146,8 +214,9 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     """Create a RowSparseNDArray (reference sparse.py row_sparse_array).
 
-    ``row_sparse_array((data, indices), shape=(M, ...))`` or a dense
-    source."""
+    ``row_sparse_array((data, indices), shape=(M, ...))`` builds the REAL
+    parts-backed container (memory ∝ nnz rows); a dense source builds the
+    facade."""
     if isinstance(arg1, tuple) and len(arg1) == 2 \
             and not onp.isscalar(arg1[0]):
         data, indices = [onp.asarray(
@@ -155,9 +224,9 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         if shape is None:
             raise MXNetError("row_sparse_array from components requires "
                              "shape")
-        dense = onp.zeros(shape, dtype or data.dtype)
-        dense[indices.astype(int)] = data
-        return _as_sparse(_dense_array(dense, ctx=ctx), RowSparseNDArray)
+        if dtype is not None:
+            data = data.astype(dtype)
+        return RowSparseNDArray.from_parts(data, indices, shape, ctx=ctx)
     src = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
     if dtype is not None:
         src = src.astype(dtype)
@@ -210,11 +279,19 @@ def cast_storage(arr: NDArray, stype: str) -> NDArray:
 
 def retain(arr: RowSparseNDArray, indices) -> RowSparseNDArray:
     """sparse_retain: zero out all rows except ``indices`` (reference
-    src/operator/tensor/sparse_retain-inl.h)."""
+    src/operator/tensor/sparse_retain-inl.h).  Parts-backed input →
+    parts-backed output (cost ∝ nnz, no densification)."""
     if not isinstance(arr, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
     idx = indices.asnumpy() if isinstance(indices, NDArray) \
         else onp.asarray(indices)
+    if arr.has_parts:
+        keep = onp.isin(onp.asarray(arr.__dict__["_sp_indices"]),
+                        idx.astype(onp.int64))
+        kept_idx = onp.asarray(arr.__dict__["_sp_indices"])[keep]
+        kept_vals = onp.asarray(arr.__dict__["_sp_values"])[keep]
+        return RowSparseNDArray.from_parts(kept_vals, kept_idx, arr.shape,
+                                           ctx=arr._ctx)
     idx = jnp.asarray(idx.astype(onp.int32))
 
     def fn(x):
@@ -235,3 +312,45 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     dense_dot = _nd_getattr("dot")
     return dense_dot(lhs, rhs, transpose_a=transpose_a,
                      transpose_b=transpose_b)
+
+
+def make_row_sparse_inplace(nd, values, indices, shape, ctx=None):
+    """Mutate ``nd`` into a parts-backed RowSparseNDArray (used by the
+    autograd grad-buffer write and kvstore row_sparse_pull, which must
+    deliver sparsity through a caller-owned array)."""
+    nd.__class__ = RowSparseNDArray
+    # drop any plain dense attribute: the class-level _data property would
+    # shadow it, silently retaining the full-size buffer forever
+    nd.__dict__.pop("_data", None)
+    nd.__dict__["_dense_cache"] = None
+    nd.__dict__["_sp_values"] = jnp.asarray(
+        values._data if isinstance(values, NDArray) else values)
+    nd.__dict__["_sp_indices"] = jnp.asarray(
+        indices._data if isinstance(indices, NDArray) else indices,
+        jnp.int32)
+    nd.__dict__["_sp_shape"] = tuple(int(s) for s in shape)
+    return nd
+
+
+def dedup_rows(indices, values):
+    """Sum duplicate row ids: (ids, rows) → (unique sorted ids, summed
+    rows).  The shared kernel behind sparse-grad accumulation and the
+    embedding sparse VJP."""
+    indices = onp.asarray(indices)
+    values = onp.asarray(values)
+    uniq, inv = onp.unique(indices, return_inverse=True)
+    summed = onp.zeros((uniq.size,) + values.shape[1:], values.dtype)
+    onp.add.at(summed, inv, values)
+    return uniq.astype(onp.int32), summed
+
+
+def merge_row_sparse(a, b):
+    """Sum two parts-backed arrays (gradient accumulation): unique union
+    of rows, summed values — still ∝ nnz."""
+    ai = onp.asarray(a.__dict__["_sp_indices"])
+    bi = onp.asarray(b.__dict__["_sp_indices"])
+    av = onp.asarray(a.__dict__["_sp_values"])
+    bv = onp.asarray(b.__dict__["_sp_values"])
+    uniq, summed = dedup_rows(onp.concatenate([ai, bi]),
+                              onp.concatenate([av, bv]))
+    return RowSparseNDArray.from_parts(summed, uniq, a.shape, ctx=a._ctx)

@@ -503,6 +503,35 @@ def embedding(data, weight, input_dim: int = 0, output_dim: int = 0,
     return jnp.take(weight, idx, axis=0)
 
 
+def _embedding_sparse_vjp_factory(static_kwargs):
+    """With sparse_grad=True the weight gradient is delivered as a
+    parts-backed RowSparseNDArray — (unique batch ids, summed cotangent
+    rows) — so backward cost scales with the batch, not the vocabulary
+    (reference: Embedding sparse_grad + row_sparse kernels in
+    src/operator/tensor/indexing_op.cc)."""
+    if not static_kwargs.get("sparse_grad"):
+        return None
+
+    def hook(in_values, outs_ct):
+        import numpy as onp
+        from ..ndarray.sparse import RowSparseNDArray, dedup_rows
+        ids, weight = in_values[0], in_values[1]
+        ct = outs_ct[0]
+        if ct is None:
+            return (None, None)
+        flat_ids = onp.asarray(ids).astype(onp.int64).ravel()
+        flat_ids = onp.clip(flat_ids, 0, weight.shape[0] - 1)
+        ct_rows = onp.asarray(ct).reshape(flat_ids.size, -1)
+        uniq, summed = dedup_rows(flat_ids, ct_rows)
+        summed = summed.reshape((uniq.size,) + tuple(weight.shape[1:]))
+        return (None, RowSparseNDArray.from_parts(summed, uniq,
+                                                  weight.shape))
+    return hook
+
+
+embedding._sparse_vjp_factory = _embedding_sparse_vjp_factory
+
+
 @register("SequenceMask")
 def sequence_mask(data, sequence_length=None, use_sequence_length: bool = False,
                   value: float = 0.0, axis: int = 0):

@@ -2,6 +2,7 @@
 tests/python/unittest/test_sparse_ndarray.py, simplified to the emulated
 TPU semantics)."""
 import numpy as onp
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.ndarray import sparse
@@ -84,3 +85,182 @@ def test_kvstore_row_sparse_pull():
     got = out.asnumpy()
     onp.testing.assert_allclose(got[[0, 3]], w.asnumpy()[[0, 3]])
     onp.testing.assert_allclose(got[[1, 2]], onp.zeros((2, 2)))
+
+
+# ---------------------------------------------------------------------------
+# round-3: REAL row-sparse path (parts-backed container, sparse embedding
+# gradients, lazy sparse optimizer updates, gathering row_sparse_pull) —
+# reference: Embedding(sparse_grad) + FComputeEx optimizer kernels
+# (src/operator/optimizer_op.cc) + kvstore.py:270 row_sparse_pull
+# ---------------------------------------------------------------------------
+
+def test_row_sparse_parts_backed_no_densify():
+    vals = onp.arange(6, dtype="float32").reshape(2, 3)
+    idx = onp.array([1, 4], "int64")
+    rs = sparse.row_sparse_array((vals, idx), shape=(6, 3))
+    assert rs.has_parts
+    assert rs.__dict__["_dense_cache"] is None   # nothing densified
+    onp.testing.assert_array_equal(rs.indices.asnumpy(), idx)
+    onp.testing.assert_array_equal(rs.data.asnumpy(), vals)
+    assert rs.shape == (6, 3)
+    # dense view on demand
+    dense = rs.asnumpy()
+    assert dense.shape == (6, 3)
+    onp.testing.assert_array_equal(dense[1], vals[0])
+    onp.testing.assert_array_equal(dense[0], onp.zeros(3))
+
+
+def test_row_sparse_retain_stays_parts():
+    vals = onp.ones((3, 2), "float32") * onp.arange(1, 4)[:, None]
+    rs = sparse.row_sparse_array((vals, [0, 2, 5]), shape=(8, 2))
+    kept = rs.retain([2, 5, 7])
+    assert kept.has_parts
+    onp.testing.assert_array_equal(kept.indices.asnumpy(), [2, 5])
+    onp.testing.assert_array_equal(kept.data.asnumpy(), vals[1:])
+
+
+def test_embedding_sparse_grad_is_row_sparse():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    vocab, dim = 50, 4
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    ids = mx.nd.array(onp.array([[3, 7, 3], [7, 9, 1]], "float32"))
+    _ = emb(ids)
+    trainer_params = emb.collect_params()
+    import mxnet_tpu.gluon as gluon
+    trainer = gluon.Trainer(trainer_params, "sgd", {"learning_rate": 0.0})
+    with autograd.record():
+        out = emb(ids)
+        loss = (out * out).sum()
+    loss.backward()
+    w = emb.weight
+    g = w.grad()
+    assert isinstance(g, sparse.RowSparseNDArray) and g.has_parts
+    onp.testing.assert_array_equal(g.indices.asnumpy(), [1, 3, 7, 9])
+    # values match the dense-path gradient on those rows
+    emb2 = nn.Embedding(vocab, dim, sparse_grad=False)
+    emb2.initialize()
+    emb2.weight.set_data(w.data())
+    t2 = gluon.Trainer(emb2.collect_params(), "sgd", {"learning_rate": 0.0})
+    with autograd.record():
+        loss2 = (emb2(ids) * emb2(ids)).sum()
+    loss2.backward()
+    dense_g = emb2.weight.grad().asnumpy()
+    onp.testing.assert_allclose(g.data.asnumpy(), dense_g[[1, 3, 7, 9]],
+                                rtol=1e-5)
+    onp.testing.assert_allclose(onp.abs(dense_g).sum(),
+                                onp.abs(g.data.asnumpy()).sum(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("optname", ["sgd", "adam"])
+def test_sparse_update_matches_dense_on_touched_rows(optname):
+    import mxnet_tpu.optimizer as opt
+    vocab, dim = 30, 5
+    rs_w = onp.random.RandomState(0).randn(vocab, dim).astype("float32")
+    idx = onp.array([2, 11, 29])
+    vals = onp.random.RandomState(1).randn(3, dim).astype("float32")
+
+    mk = (lambda: opt.SGD(learning_rate=0.1, momentum=0.9)) \
+        if optname == "sgd" else (lambda: opt.Adam(learning_rate=0.1))
+    # sparse path
+    o1 = mk()
+    w1 = mx.nd.array(rs_w.copy())
+    st1 = o1.create_state(0, w1)
+    g_sp = sparse.row_sparse_array((vals, idx), shape=(vocab, dim))
+    o1.update(0, w1, g_sp, st1)
+    # dense path: same grad with zeros elsewhere
+    o2 = mk()
+    w2 = mx.nd.array(rs_w.copy())
+    st2 = o2.create_state(0, w2)
+    dense_g = onp.zeros((vocab, dim), "float32")
+    dense_g[idx] = vals
+    o2.update(0, w2, mx.nd.array(dense_g), st2)
+    # touched rows must match the dense update exactly
+    onp.testing.assert_allclose(w1.asnumpy()[idx], w2.asnumpy()[idx],
+                                rtol=1e-5, atol=1e-6)
+    # untouched rows unchanged under the lazy (sparse) policy
+    mask = onp.ones(vocab, bool)
+    mask[idx] = False
+    onp.testing.assert_array_equal(w1.asnumpy()[mask], rs_w[mask])
+
+
+def test_row_sparse_pull_gathers_parts():
+    kv = mx.kv.create("local")
+    table = onp.random.RandomState(2).randn(20, 3).astype("float32")
+    kv.init("emb", mx.nd.array(table))
+    out = mx.nd.zeros((20, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([4.0, 9.0, 4.0]))
+    assert isinstance(out, sparse.RowSparseNDArray) and out.has_parts
+    onp.testing.assert_array_equal(out.indices.asnumpy(), [4, 9])
+    onp.testing.assert_allclose(out.data.asnumpy(), table[[4, 9]], rtol=1e-6)
+    # dense view still correct (zeros elsewhere)
+    dense = out.asnumpy()
+    assert onp.abs(dense[0]).sum() == 0
+
+
+def test_large_vocab_sparse_embedding_trains():
+    """The point of row_sparse: a large-vocab embedding trains with grads
+    and updates proportional to the batch, and the grad buffer holds only
+    the live rows."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    import mxnet_tpu.gluon as gluon
+    vocab, dim, batch = 100_000, 16, 32
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    rs = onp.random.RandomState(3)
+    ids = mx.nd.array(rs.randint(0, vocab, (batch,)).astype("float32"))
+    _ = emb(ids)
+    # the MSE mean divides grads by batch*dim; scale lr so few steps move
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 120.0})
+    target = mx.nd.array(rs.randn(batch, dim).astype("float32"))
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            diff = emb(ids) - target
+            loss = (diff * diff).mean()
+        loss.backward()
+        g = emb.weight.grad()
+        assert g.has_parts
+        assert g.data.shape[0] == len(onp.unique(ids.asnumpy()))
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_sparse_grad_through_non_leaf_weight_densifies():
+    """When the embedding weight is itself a recorded computation (tied /
+    scaled weights), the sparse cotangent must densify to flow through the
+    upstream node's vjp instead of crashing."""
+    from mxnet_tpu import autograd
+    w = mx.nd.array(onp.random.RandomState(0).randn(10, 4).astype("float32"))
+    w.attach_grad()
+    ids = mx.nd.array(onp.array([1.0, 3.0, 1.0]))
+    with autograd.record():
+        w2 = w * 2.0
+        out = mx.nd.Embedding(ids, w2, input_dim=10, output_dim=4,
+                              sparse_grad=True)
+        loss = (out * out).sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    # chain rule through the scale: dL/dw = 2 * dL/dw2
+    # per occurrence: dL/dout = 2*out = 4w; dL/dw2 = 4w; dL/dw = 2*4w = 8w
+    want = onp.zeros((10, 4), "float32")
+    wv = w.asnumpy()
+    for i in [1, 3, 1]:
+        want[i] += 8 * wv[i]
+    onp.testing.assert_allclose(g, want, rtol=1e-5)
+
+
+def test_row_sparse_pull_out_of_range_ids_dropped():
+    kv = mx.kv.create("local")
+    kv.init("t", mx.nd.array(onp.arange(8, dtype="f").reshape(4, 2)))
+    out = mx.nd.zeros((4, 2))
+    kv.row_sparse_pull("t", out=out, row_ids=mx.nd.array([1.0, 9.0]))
+    onp.testing.assert_array_equal(out.indices.asnumpy(), [1])
+    dense = out.asnumpy()
+    onp.testing.assert_array_equal(dense[1], [2.0, 3.0])
+    # absent / out-of-range rows are zero, never clamped gathers
+    assert onp.abs(dense[[0, 2, 3]]).sum() == 0
