@@ -14,6 +14,7 @@ from . import rnn           # noqa: F401
 from . import control_flow  # noqa: F401
 from . import vision        # noqa: F401
 from . import contrib_ops   # noqa: F401
+from . import detection     # noqa: F401
 from . import quantization  # noqa: F401
 from . import pallas_attention  # noqa: F401
 

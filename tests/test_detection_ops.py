@@ -1,0 +1,210 @@
+"""Detection op tests: MultiBoxTarget/Detection, Proposal, PSROIPooling
+(reference src/operator/contrib/multibox_*.cc, proposal.cc,
+psroi_pooling.cc; strategy of tests/python/unittest/test_contrib_operator
+.py test_multibox_target_op etc.) + an SSD-style training smoke."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def test_multibox_target_assignment():
+    # 4 anchors, one perfectly covering the gt, one overlapping, two far
+    anchors = onp.array([[[0.1, 0.1, 0.5, 0.5],
+                          [0.12, 0.12, 0.52, 0.52],
+                          [0.6, 0.6, 0.9, 0.9],
+                          [0.0, 0.0, 0.05, 0.05]]], "float32")
+    labels = onp.array([[[2.0, 0.1, 0.1, 0.5, 0.5],
+                         [-1, -1, -1, -1, -1]]], "float32")
+    cls_preds = onp.zeros((1, 3, 4), "float32")
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds))
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 3.0          # gt class 2 -> target 3 (bg reserved 0)
+    assert ct[2] == 0.0 and ct[3] == 0.0
+    lm = loc_m.asnumpy()[0].reshape(4, 4)
+    assert lm[0].sum() == 4 and lm[3].sum() == 0
+    # the perfectly-matching anchor encodes ~zero offsets
+    lt = loc_t.asnumpy()[0].reshape(4, 4)
+    onp.testing.assert_allclose(lt[0], onp.zeros(4), atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = onp.random.RandomState(0).uniform(
+        0, 0.5, (1, 20, 2)).astype("float32")
+    anchors = onp.concatenate([anchors, anchors + 0.3], axis=2)
+    anchors[0, 0] = [0.1, 0.1, 0.4, 0.4]
+    labels = onp.array([[[0.0, 0.1, 0.1, 0.4, 0.4]]], "float32")
+    cls_preds = onp.random.RandomState(1).randn(1, 2, 20).astype("float32")
+    _, _, cls_t = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds),
+        negative_mining_ratio=2.0, negative_mining_thresh=0.4)
+    ct = cls_t.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_neg = (ct == 0).sum()
+    n_ign = (ct == -1).sum()
+    assert n_pos >= 1
+    assert n_neg <= max(2 * n_pos, 1) + 1
+    assert n_ign > 0             # mining leaves unpicked anchors ignored
+
+
+def test_multibox_detection_decodes_and_nms():
+    anchors = onp.array([[[0.1, 0.1, 0.5, 0.5],
+                          [0.11, 0.11, 0.51, 0.51],
+                          [0.6, 0.6, 0.9, 0.9]]], "float32")
+    cls_prob = onp.array([[[0.1, 0.2, 0.9],      # background
+                           [0.8, 0.7, 0.05],     # class 0
+                           [0.1, 0.1, 0.05]]], "float32")
+    loc_pred = onp.zeros((1, 12), "float32")
+    out = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold=0.5).asnumpy()[0]
+    # best row: class 0 @ anchor0; overlapping anchor1 suppressed; the
+    # far anchor2 (score 0.05 >= default threshold 0.01) stays
+    assert out[0, 0] == 0.0 and abs(out[0, 1] - 0.8) < 1e-6
+    onp.testing.assert_allclose(out[0, 2:], [0.1, 0.1, 0.5, 0.5], atol=1e-5)
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2
+    onp.testing.assert_allclose(kept[1, 2:], [0.6, 0.6, 0.9, 0.9],
+                                atol=1e-5)
+
+
+def test_proposal_shapes_and_validity():
+    rs = onp.random.RandomState(2)
+    B, A, H, W = 1, 9, 4, 4
+    cls_prob = rs.uniform(0, 1, (B, 2 * A, H, W)).astype("float32")
+    bbox_pred = rs.uniform(-0.2, 0.2, (B, 4 * A, H, W)).astype("float32")
+    im_info = onp.array([[64.0, 64.0, 1.0]], "float32")
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, feature_stride=16,
+        rpn_min_size=4, scales=(8, 16, 32), ratios=(0.5, 1.0, 2.0))
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1] <= r[:, 3]).all() and (r[:, 2] <= r[:, 4]).all()
+    assert (r[:, 1:] >= 0).all() and (r[:, 3] <= 63).all()
+
+
+def test_psroi_pooling_values_and_grad():
+    B, od, g, H, W = 1, 2, 2, 8, 8
+    data = onp.arange(B * od * g * g * H * W, dtype="float32").reshape(
+        B, od * g * g, H, W) / 100.0
+    rois = onp.array([[0, 0, 0, 63, 63]], "float32")  # whole image, scale 1/8
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.125,
+        output_dim=od, pooled_size=g)
+    got = out.asnumpy()
+    assert got.shape == (1, od, g, g)
+    # reference roi end = (round(63)+1)*0.125 = 8.0 -> bin_w = 4
+    want00 = data[0, 0, 0:4, 0:4].mean()
+    onp.testing.assert_allclose(got[0, 0, 0, 0], want00, rtol=1e-5)
+    want11 = data[0, 3, 4:8, 4:8].mean()
+    onp.testing.assert_allclose(got[0, 0, 1, 1], want11, rtol=1e-5)
+    # gradient flows (mid-network op)
+    x = mx.nd.array(data)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.PSROIPooling(x, mx.nd.array(rois),
+                                       spatial_scale=0.125, output_dim=od,
+                                       pooled_size=g)
+        loss = (y * y).sum()
+    loss.backward()
+    assert onp.isfinite(x.grad.asnumpy()).all()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_ssd_style_training_descends():
+    """Tiny SSD head: conv features -> cls+loc preds; MultiBoxTarget
+    supplies targets; joint loss descends (reference
+    example/ssd train.py capability)."""
+    from mxnet_tpu.gluon import nn
+    rs = onp.random.RandomState(3)
+    B, N_CLS = 8, 3
+
+    class SSDHead(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.body = nn.HybridSequential()
+            self.body.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+                          nn.Conv2D(16, 3, padding=1, activation="relu"))
+            # MultiBoxPrior yields len(sizes)+len(ratios)-1 = 3 per cell
+            self.cls = nn.Conv2D((N_CLS + 1) * 3, 3, padding=1)
+            self.loc = nn.Conv2D(4 * 3, 3, padding=1)
+
+        def hybrid_forward(self, F, x):
+            f = self.body(x)
+            return self.cls(f), self.loc(f)
+
+    net = SSDHead()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(rs.randn(B, 3, 16, 16).astype("float32"))
+    cls_p, loc_p = net(x)
+
+    anchors = mx.nd.contrib.MultiBoxPrior(
+        mx.nd.zeros((1, 3, 16, 16)), sizes=(0.3, 0.6), ratios=(1.0, 2.0))
+    N = anchors.shape[1]
+    labels = onp.full((B, 2, 5), -1.0, "float32")
+    for b in range(B):
+        labels[b, 0] = [rs.randint(0, N_CLS), 0.2, 0.2, 0.7, 0.7]
+    labels = mx.nd.array(labels)
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    l1 = gluon.loss.HuberLoss()
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            cls_p, loc_p = net(x)
+            cls_pred = cls_p.transpose(axes=(0, 2, 3, 1)).reshape(
+                B, -1, N_CLS + 1)          # (B, N, C)
+            loc_pred = loc_p.transpose(axes=(0, 2, 3, 1)).reshape(B, -1)
+            with autograd.pause():
+                loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+                    anchors, labels, cls_pred.transpose(axes=(0, 2, 1)))
+            cls_loss = ce(cls_pred.reshape(-1, N_CLS + 1),
+                          cls_t.reshape(-1))
+            loc_loss = l1(loc_pred * loc_m, loc_t * loc_m)
+            loss = cls_loss.mean() + loc_loss.mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+
+def test_multibox_target_mining_never_wipes_positives():
+    """negative_mining with zero candidates must not overwrite positives
+    (n_neg clamped to the candidate count)."""
+    anchors = onp.array([[[0.1, 0.1, 0.5, 0.5],
+                          [0.1, 0.1, 0.52, 0.52],
+                          [0.1, 0.1, 0.48, 0.48],
+                          [0.12, 0.1, 0.5, 0.5]]], "float32")
+    labels = onp.array([[[1.0, 0.1, 0.1, 0.5, 0.5]]], "float32")
+    preds = onp.zeros((1, 2, 4), "float32")
+    _, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(preds),
+        overlap_threshold=0.95, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.1)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 2.0).sum() >= 1          # the positive survives
+    assert loc_m.asnumpy().sum() >= 4
+
+
+def test_proposal_batch_index_correct_when_all_undersized():
+    rs = onp.random.RandomState(5)
+    B, A, H, W = 2, 9, 2, 2
+    cls_prob = rs.uniform(0, 1, (B, 2 * A, H, W)).astype("float32")
+    bbox_pred = onp.full((B, 4 * A, H, W), -5.0, "float32")  # tiny boxes
+    im_info = onp.array([[64.0, 64.0, 1.0]] * B, "float32")
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=20, rpn_post_nms_top_n=5, feature_stride=16,
+        rpn_min_size=16, scales=(8, 16, 32), ratios=(0.5, 1.0, 2.0))
+    r = rois.asnumpy()
+    # every batch's rows carry its own index and real (clipped) boxes
+    onp.testing.assert_array_equal(r[:5, 0], onp.zeros(5))
+    onp.testing.assert_array_equal(r[5:, 0], onp.ones(5))
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()

@@ -637,6 +637,12 @@ COVERED_ELSEWHERE = {
     "flash_attention", "_contrib_flash_attention",
     # tested in tests/test_custom_op.py (imperative/gluon/module paths)
     "Custom", "custom",
+    # tested in tests/test_detection_ops.py (value + SSD training checks)
+    "_contrib_MultiBoxTarget", "MultiBoxTarget",
+    "_contrib_MultiBoxDetection", "MultiBoxDetection",
+    "_contrib_Proposal", "Proposal",
+    "_contrib_MultiProposal", "MultiProposal",
+    "_contrib_PSROIPooling", "PSROIPooling",
     # tested in tests/test_transformer.py (numpy-oracle value checks)
     "_contrib_div_sqrt_dim", "div_sqrt_dim",
     "_contrib_interleaved_matmul_selfatt_qk",
