@@ -2,5 +2,6 @@
 ``python/mxnet/contrib/``)."""
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import stablehlo  # noqa: F401
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "stablehlo"]

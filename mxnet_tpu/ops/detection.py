@@ -188,10 +188,14 @@ def multibox_detection(cls_prob, loc_pred, anchors, clip=True,
         out = onp.full((B, N, 6), -1.0, onp.float32)
         for b in range(B):
             probs = prob_a[b]                       # (C, N)
-            fg = probs[1:] if background_id == 0 else onp.delete(
-                probs, background_id, axis=0)
-            ids = fg.argmax(axis=0).astype(onp.float32)
-            scores = fg.max(axis=0)
+            # reference multibox_detection.cc:125: id = raw argmax over
+            # non-background classes, output as id-1 regardless of which
+            # class is background
+            masked = probs.copy()
+            masked[background_id] = -onp.inf
+            raw = masked.argmax(axis=0)
+            ids = (raw - 1).astype(onp.float32)
+            scores = masked.max(axis=0)
             keep = scores >= threshold
             boxes = _decode_boxes(anc, loc_a[b].reshape(N, 4), var, clip)
             order = onp.argsort(-scores, kind="stable")
@@ -262,18 +266,23 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
             scores = prob_a[b, A:].transpose(1, 2, 0).reshape(-1)
             deltas = pred_a[b].reshape(A, 4, H, W).transpose(
                 2, 3, 0, 1).reshape(-1, 4)
-            # decode (cx/cy/w/h deltas like Fast-RCNN bbox_transform_inv)
-            aw = anchors[:, 2] - anchors[:, 0] + 1
-            ah = anchors[:, 3] - anchors[:, 1] + 1
-            axc = anchors[:, 0] + 0.5 * (aw - 1)
-            ayc = anchors[:, 1] + 0.5 * (ah - 1)
-            pxc = deltas[:, 0] * aw + axc
-            pyc = deltas[:, 1] * ah + ayc
-            pw = onp.exp(onp.clip(deltas[:, 2], -10, 10)) * aw
-            ph = onp.exp(onp.clip(deltas[:, 3], -10, 10)) * ah
-            boxes = onp.stack([pxc - 0.5 * (pw - 1), pyc - 0.5 * (ph - 1),
-                               pxc + 0.5 * (pw - 1), pyc + 0.5 * (ph - 1)],
-                              axis=1)
+            if iou_loss:
+                # IoU-loss decode: deltas are direct corner offsets
+                # (reference proposal.cc IoUTransformInv :93)
+                boxes = anchors + deltas
+            else:
+                # cx/cy/w/h deltas (Fast-RCNN BBoxTransformInv)
+                aw = anchors[:, 2] - anchors[:, 0] + 1
+                ah = anchors[:, 3] - anchors[:, 1] + 1
+                axc = anchors[:, 0] + 0.5 * (aw - 1)
+                ayc = anchors[:, 1] + 0.5 * (ah - 1)
+                pxc = deltas[:, 0] * aw + axc
+                pyc = deltas[:, 1] * ah + ayc
+                pw = onp.exp(onp.clip(deltas[:, 2], -10, 10)) * aw
+                ph = onp.exp(onp.clip(deltas[:, 3], -10, 10)) * ah
+                boxes = onp.stack(
+                    [pxc - 0.5 * (pw - 1), pyc - 0.5 * (ph - 1),
+                     pxc + 0.5 * (pw - 1), pyc + 0.5 * (ph - 1)], axis=1)
             boxes[:, 0::2] = onp.clip(boxes[:, 0::2], 0, im_w - 1)
             boxes[:, 1::2] = onp.clip(boxes[:, 1::2], 0, im_h - 1)
             ms = rpn_min_size * im_scale
@@ -323,7 +332,8 @@ def psroi_pooling(data, rois, spatial_scale: float = 0.0625,
     window of its own (c, i, j) channel slice — runs on-device so R-FCN
     heads train without host round-trips.
     """
-    g = int(group_size) if group_size else int(pooled_size)
+    p = int(pooled_size)
+    g = int(group_size) if group_size else p
     B, CD, H, W = data.shape
     R = rois.shape[0]
     od = int(output_dim) if output_dim else CD // (g * g)
@@ -337,17 +347,21 @@ def psroi_pooling(data, rois, spatial_scale: float = 0.0625,
     y2 = (jnp.round(rois[:, 4]) + 1.0) * spatial_scale
     rw = jnp.maximum(x2 - x1, 0.1)
     rh = jnp.maximum(y2 - y1, 0.1)
-    bin_w = rw / g
-    bin_h = rh / g
+    bin_w = rw / p
+    bin_h = rh / p
 
     feat = data.reshape(B, od, g, g, H, W)[batch_idx]  # (R, od, g, g, H, W)
     cols = jnp.arange(W, dtype=jnp.float32)
     rows_ = jnp.arange(H, dtype=jnp.float32)
 
     outs = []
-    for i in range(g):          # static g×g loop: unrolled, fully batched
+    for i in range(p):          # static p×p loop: unrolled, fully batched
         row_out = []
-        for j in range(g):
+        for j in range(p):
+            # output bin (i, j) reads group channel (gh, gw) =
+            # floor(i*g/p), floor(j*g/p) — reference psroi_pooling.cc:94
+            gh = (i * g) // p
+            gw = (j * g) // p
             bx1 = jnp.floor(x1 + j * bin_w)
             bx2 = jnp.ceil(x1 + (j + 1) * bin_w)
             by1 = jnp.floor(y1 + i * bin_h)
@@ -358,8 +372,8 @@ def psroi_pooling(data, rois, spatial_scale: float = 0.0625,
                   & (rows_[None, :] < by2[:, None])).astype(data.dtype)
             mask = my[:, :, None] * mx[:, None, :]          # (R, H, W)
             count = jnp.maximum(mask.sum(axis=(1, 2)), 1.0)  # (R,)
-            sl = feat[:, :, i, j]                            # (R, od, H, W)
+            sl = feat[:, :, gh, gw]                          # (R, od, H, W)
             pooled = (sl * mask[:, None]).sum(axis=(2, 3)) / count[:, None]
             row_out.append(pooled)
-        outs.append(jnp.stack(row_out, axis=-1))             # (R, od, g)
-    return jnp.stack(outs, axis=-2)                          # (R, od, g, g)
+        outs.append(jnp.stack(row_out, axis=-1))             # (R, od, p)
+    return jnp.stack(outs, axis=-2)                          # (R, od, p, p)

@@ -208,3 +208,22 @@ def test_proposal_batch_index_correct_when_all_undersized():
     onp.testing.assert_array_equal(r[:5, 0], onp.zeros(5))
     onp.testing.assert_array_equal(r[5:, 0], onp.ones(5))
     assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+
+
+def test_psroi_pooling_group_differs_from_pooled():
+    """pooled_size and group_size are independent (reference
+    psroi_pooling.cc:94: group = floor(p*g/pooled))."""
+    od, g, p = 1, 2, 4
+    data = onp.random.RandomState(7).randn(1, od * g * g, 8, 8).astype(
+        "float32")
+    rois = onp.array([[0, 0, 0, 63, 63]], "float32")
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.125,
+        output_dim=od, pooled_size=p, group_size=g)
+    assert out.shape == (1, od, p, p)
+    # output bin (0,0) and (1,1) both read group channel (0,0) = slice 0
+    # (floor(0*2/4)=0, floor(1*2/4)=0); bin (2,2) reads (1,1) = slice 3
+    got = out.asnumpy()
+    want22 = data[0, 3, 4:6, 4:6].mean()  # bin_w = 8/4 = 2 -> rows 4..5
+    onp.testing.assert_allclose(got[0, 0, 2, 2], want22, rtol=1e-5)

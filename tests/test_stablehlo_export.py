@@ -1,0 +1,39 @@
+"""StableHLO export/import (deployment interchange; the reference's ONNX
+role, contrib/onnx/mx2onnx/export_onnx.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import stablehlo
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.gluon.utils import materialize_params
+
+
+def test_export_reload_matches_small_net(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    x = onp.random.RandomState(0).randn(2, 3, 12, 12).astype("float32")
+    want = net(mx.nd.array(x)).asnumpy()
+
+    prefix = str(tmp_path / "smallnet")
+    path = stablehlo.export_block(prefix, net, (2, 3, 12, 12))
+    assert path.endswith("-stablehlo.bin")
+    fn = stablehlo.import_block(prefix)
+    got = fn(mx.nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_reload_matches_resnet(tmp_path):
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    materialize_params(net, mx.nd.zeros((1, 3, 32, 32)))
+    x = onp.random.RandomState(1).randn(2, 3, 32, 32).astype("float32")
+    want = net(mx.nd.array(x)).asnumpy()
+
+    prefix = str(tmp_path / "resnet18")
+    stablehlo.export_block(prefix, net, (2, 3, 32, 32))
+    fn = stablehlo.import_block(prefix)
+    got = fn(x).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
