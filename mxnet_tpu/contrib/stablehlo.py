@@ -104,17 +104,21 @@ def import_block(prefix: str, epoch: int = 0):
     from .. import ndarray as nd
     from ..ndarray.ndarray import _wrap
 
+    import jax
+
     with open("%s-stablehlo.bin" % prefix, "rb") as f:
         exported = jexport.deserialize(f.read())
     loaded = nd.load("%s-%04d.params" % (prefix, epoch))
     # parameter order matches export: sorted by parameter name
     names = sorted(k[len("arg:"):] for k in loaded)
     pvals = [loaded["arg:" + n]._data for n in names]
+    # compile once at load: exported.call outside jit re-traces per call
+    run = jax.jit(lambda x: exported.call(pvals, x))
 
     def fn(x):
         import jax.numpy as jnp
         xv = x._data if hasattr(x, "_data") else jnp.asarray(x)
-        out = exported.call(pvals, xv)
+        out = run(xv)
         if isinstance(out, (list, tuple)):
             return [_wrap(o) for o in out]
         return _wrap(out)

@@ -182,6 +182,12 @@ def multibox_detection(cls_prob, loc_pred, anchors, clip=True,
     """
     var = tuple(float(v) for v in variances)
     B, C, N = cls_prob.shape
+    if background_id != 0:
+        # the reference kernel hardcodes class 0 as background (its class
+        # loop starts at j=1 and outputs argmax-1); any other value would
+        # make foreground ids collide with the -1 suppressed marker
+        raise ValueError("MultiBoxDetection supports background_id=0 only "
+                         "(like the reference multibox_detection.cc)")
 
     def host(prob_a, loc_a, anchors_a):
         anc = anchors_a.reshape(-1, 4).astype(onp.float32)
