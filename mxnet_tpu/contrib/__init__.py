@@ -3,5 +3,9 @@
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
 from . import stablehlo  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import text  # noqa: F401
 
-__all__ = ["amp", "quantization", "stablehlo"]
+__all__ = ["amp", "quantization", "stablehlo", "svrg_optimization",
+           "tensorboard", "text"]

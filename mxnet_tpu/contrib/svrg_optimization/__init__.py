@@ -1,0 +1,6 @@
+"""SVRG optimization (reference
+``python/mxnet/contrib/svrg_optimization/``)."""
+from .svrg_module import SVRGModule  # noqa: F401
+from .svrg_optimizer import AssignmentOptimizer  # noqa: F401
+
+__all__ = ["SVRGModule", "AssignmentOptimizer"]

@@ -1,0 +1,150 @@
+"""SVRG training (reference
+``python/mxnet/contrib/svrg_optimization/svrg_module.py``).
+
+Stochastic Variance-Reduced Gradient: every ``update_freq`` epochs the
+FULL-dataset gradient is computed at a snapshot of the weights; per-batch
+updates then use the variance-reduced gradient
+
+    g = grad(w, batch) - grad(w_snapshot, batch) + full_grad(w_snapshot)
+
+The reference routes the correction through a wrapped optimizer with
+mangled key names (_SVRGOptimizer); here the correction is applied
+directly to the gradient buffers before the standard ``Module.update`` —
+same math, no key-name plumbing (the functional runtime makes gradient
+editing explicit and cheap).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG updates (reference svrg_module.py:31)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), context=None,
+                 update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, context=context, **kwargs)
+        if update_freq < 1:
+            raise ValueError("update_freq must be >= 1 epoch")
+        self.update_freq = update_freq
+        # snapshot module: same symbol, holds w~ and evaluates grad(w~, batch)
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, context=context,
+                               **kwargs)
+        self._param_dict = None   # name -> full grad at the snapshot
+
+    # -- lifecycle: keep the snapshot module in lockstep ----------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module,
+                     grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, shared_module,
+                           grad_req)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(initializer, arg, aux,
+                                  allow_missing=True, force_init=True,
+                                  allow_extra=True)
+
+    # -- SVRG ----------------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Snapshot w~ := w and accumulate the mean full-dataset gradient
+        at w~ (reference svrg_module.py:292)."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg_params=arg, aux_params=aux)
+        train_data.reset()
+        nbatch = 0
+        accum = {n: None for n in self._trainable_names()}
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            gd = self._mod_aux._exec.grad_dict
+            for n in accum:
+                g = gd[n].asnumpy()
+                accum[n] = g if accum[n] is None else accum[n] + g
+            nbatch += 1
+        if nbatch == 0:
+            raise ValueError("update_full_grads: empty train_data")
+        self._param_dict = {n: accum[n] / nbatch for n in accum}
+
+    def _trainable_names(self):
+        """Params that actually carry gradients (fixed params' grad
+        buffers are None)."""
+        gd = self._exec.grad_dict
+        return [n for n in self._param_names if gd.get(n) is not None]
+
+    def forward_backward(self, data_batch):
+        """fwd+bwd on BOTH weights (current and snapshot) for the same
+        batch (reference svrg_module.py:234)."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        if self._param_dict is not None:
+            self._mod_aux.forward(data_batch, is_train=True)
+            self._mod_aux.backward()
+
+    def update(self):
+        """Variance-reduce the gradient buffers, then standard update
+        (reference svrg_module.py:274 + _svrg_grads_update_rule).  The
+        correction stays on-device — no host round-trips in the hot loop."""
+        if self._param_dict is not None:
+            from ... import nd
+            gd = self._exec.grad_dict
+            gd_aux = self._mod_aux._exec.grad_dict
+            for n in self._param_dict:
+                mu = nd.array(self._param_dict[n].astype(
+                    str(gd[n].dtype)))
+                gd[n][:] = gd[n] - gd_aux[n] + mu
+        super().update()
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=1, validation_metric=None):
+        """SVRG fit loop: refresh full grads every ``update_freq`` epochs
+        (reference svrg_module.py:443); scores ``eval_data`` per epoch."""
+        from ... import metric as metric_mod
+        from ... import init as init_mod
+        if not self.binded:
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label)
+        if not self.params_initialized:
+            self.init_params(initializer or init_mod.Uniform(0.01))
+        if not self.optimizer_initialized:
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params)
+        eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            train_data.reset()
+            eval_metric.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    batch_end_callback(type("P", (), {
+                        "epoch": epoch, "nbatch": nbatch,
+                        "eval_metric": eval_metric})())
+            if eval_data is not None:
+                val_metric = metric_mod.create(validation_metric
+                                               or eval_metric.__class__())
+                self.score(eval_data, val_metric)
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self.symbol, *self.get_params())
+        return eval_metric

@@ -1,0 +1,25 @@
+"""SVRG optimizer shims (reference
+``python/mxnet/contrib/svrg_optimization/svrg_optimizer.py``).
+
+The reference's ``_SVRGOptimizer`` exists to smuggle the full-gradient
+correction through the kvstore key namespace.  In this build the
+correction is applied to the gradient buffers inside ``SVRGModule.update``
+(see svrg_module.py), so the "optimizer" here is the assignment helper the
+reference also ships: ``_AssignmentOptimizer`` writes the pushed value
+straight into the weight (used for broadcasting full grads via kvstore).
+"""
+from __future__ import annotations
+
+from ...optimizer import Optimizer, register
+
+__all__ = ["AssignmentOptimizer"]
+
+
+@register
+class AssignmentOptimizer(Optimizer):
+    """weight := grad (reference svrg_optimizer.py:30 _AssignmentOptimizer:
+    kvstore-mediated state broadcast, not gradient descent)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight[:] = grad

@@ -1,0 +1,43 @@
+"""TensorBoard metric logging (reference
+``python/mxnet/contrib/tensorboard.py``: LogMetricsCallback over the
+``tensorboard`` SummaryWriter).
+
+The writer dependency is optional exactly like the reference: construction
+fails with guidance when no TensorBoard package is importable.  A
+``summary_writer`` argument allows injecting any object with
+``add_scalar(tag, value, step)`` (e.g. for tests or custom sinks).
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Epoch-end callback pushing eval metrics to TensorBoard
+    (reference tensorboard.py:34)."""
+
+    def __init__(self, logging_dir=None, prefix=None, summary_writer=None):
+        self.prefix = prefix
+        self.step = 0
+        if summary_writer is not None:
+            self.summary_writer = summary_writer
+            return
+        try:
+            from tensorboardX import SummaryWriter  # type: ignore
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError:
+                raise ImportError(
+                    "LogMetricsCallback needs a SummaryWriter: install "
+                    "tensorboardX, use torch's, or pass summary_writer=")
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """BatchEndParam/epoch-end hook (reference __call__)."""
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            tag = "%s-%s" % (self.prefix, name) if self.prefix else name
+            self.summary_writer.add_scalar(tag, value, self.step)

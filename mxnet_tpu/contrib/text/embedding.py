@@ -1,0 +1,210 @@
+"""Token embeddings (reference ``python/mxnet/contrib/text/embedding.py``).
+
+Zero-egress build: pretrained vectors load from LOCAL files (the reference
+downloads GloVe/fastText archives; here ``pretrained_file_path`` points at
+an already-present text file — the download step is a recorded descope,
+README "Design decisions").  File format is the standard one the reference
+parses: one token per line, ``token<delim>v1<delim>v2...``.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as onp
+
+from ...base import MXNetError
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "GloVe", "FastText",
+           "CompositeEmbedding"]
+
+_EMBEDDING_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register a TokenEmbedding subclass under its lowercase name
+    (reference embedding.py:40)."""
+    _EMBEDDING_REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding (reference embedding.py:63)."""
+    key = embedding_name.lower()
+    if key not in _EMBEDDING_REGISTRY:
+        raise MXNetError("unknown embedding %r (registered: %s)"
+                         % (embedding_name, sorted(_EMBEDDING_REGISTRY)))
+    return _EMBEDDING_REGISTRY[key](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names per embedding (reference
+    embedding.py:90) — informational; files must be provided locally."""
+    table = {cls.__name__.lower(): list(cls.pretrained_file_names)
+             for cls in _EMBEDDING_REGISTRY.values()}
+    if embedding_name is None:
+        return table
+    return table[embedding_name.lower()]
+
+
+class TokenEmbedding(Vocabulary):
+    """Base embedding: a vocabulary whose every index carries a vector
+    (reference embedding.py:133 _TokenEmbedding)."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading --------------------------------------------------------
+    def _load_embedding(self, pretrained_file_path, elem_delim=" ",
+                        init_unknown_vec=onp.zeros, encoding="utf8"):
+        if not os.path.isfile(pretrained_file_path):
+            raise MXNetError(
+                "pretrained embedding file %r not found; this build has no "
+                "network egress — place the file locally (README descopes)"
+                % pretrained_file_path)
+        vectors = {}
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2:
+                    continue  # fastText-style count header
+                token, elems = parts[0], parts[1:]
+                if len(elems) <= 1:
+                    continue  # malformed line, skip like the reference
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                elif len(elems) != self._vec_len:
+                    continue
+                if token not in vectors:
+                    vectors[token] = onp.asarray(elems, dtype=onp.float32)
+        for token in sorted(vectors):
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+        self._idx_to_vec = onp.zeros((len(self), self._vec_len),
+                                     onp.float32)
+        self._idx_to_vec[0] = init_unknown_vec(self._vec_len)
+        for token, vec in vectors.items():
+            self._idx_to_vec[self._token_to_idx[token]] = vec
+
+    def _build_for_vocabulary(self, vocabulary, source):
+        """Restrict ``source``'s vectors to ``vocabulary``'s index space
+        (reference _build_embedding_for_vocabulary)."""
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._vec_len = source.vec_len
+        self._idx_to_vec = source.get_vecs_by_tokens(
+            self._idx_to_token).asnumpy()
+
+    # -- access ---------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        from ... import ndarray as nd
+        return nd.array(self._idx_to_vec)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Look up vectors; unknown tokens get the unknown vector
+        (reference embedding.py:366)."""
+        from ... import ndarray as nd
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        rows = self._idx_to_vec[[self._token_to_idx.get(t, 0)
+                                 for t in toks]]
+        return nd.array(rows[0] if single else rows)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (reference embedding.py:405)."""
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        vals = onp.asarray(new_vectors.asnumpy()
+                           if hasattr(new_vectors, "asnumpy")
+                           else new_vectors, onp.float32)
+        vals = vals.reshape(len(toks), self._vec_len)
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise MXNetError("token %r is not indexed" % (t,))
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user-supplied file (reference embedding.py:625)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            # restrict the already-loaded vectors: one parse, not two
+            source = CustomEmbedding.__new__(CustomEmbedding)
+            source.__dict__.update(self.__dict__)
+            self._build_for_vocabulary(vocabulary, source)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe vectors from a local file (reference embedding.py:469)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=".", init_unknown_vec=onp.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(
+            os.path.join(embedding_root, pretrained_file_name),
+            " ", init_unknown_vec)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText vectors from a local file (reference embedding.py:541)."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.fr.vec",
+        "wiki.de.vec", "wiki.es.vec", "wiki.ja.vec", "wiki.ru.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=".", init_unknown_vec=onp.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(
+            os.path.join(embedding_root, pretrained_file_name),
+            " ", init_unknown_vec)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    embedding.py:655)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        parts = [e.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for e in token_embeddings]
+        self._idx_to_vec = onp.concatenate(parts, axis=1)
+        self._vec_len = self._idx_to_vec.shape[1]

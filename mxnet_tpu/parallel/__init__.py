@@ -37,6 +37,7 @@ from .collectives import (  # noqa: F401
 from .data_parallel import DataParallelStep  # noqa: F401
 from .ring_attention import (  # noqa: F401
     blockwise_attention, ring_attention, ring_attention_sharded)
+from .pipeline import pipeline_apply  # noqa: F401
 
 __all__ = [
     "Mesh", "NamedSharding", "P",
@@ -44,6 +45,7 @@ __all__ = [
     "allreduce", "all_gather", "pmean", "ppermute", "psum", "reduce_scatter",
     "DataParallelStep", "ring_attention", "ring_attention_sharded",
     "blockwise_attention", "shard_batch", "replicate", "initialize",
+    "pipeline_apply",
 ]
 
 

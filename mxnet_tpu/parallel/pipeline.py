@@ -1,0 +1,92 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp``
+mesh axis.
+
+Reference capability: absent upstream (SURVEY.md §2.3 marks pipeline
+parallelism optional — the reference's closest notion is ``group2ctx``
+device placement).  TPU-native design: each pipeline stage lives on one
+slice of the ``pp`` axis; microbatches stream through the ring with
+``lax.ppermute`` neighbour exchanges inside ONE compiled program — no
+host scheduling, and XLA overlaps each tick's compute with the shift.
+
+    mesh = Mesh(devices.reshape(pp,), ("pp",))
+    out = pipeline_apply(stage_fn, stacked_params, microbatches, mesh)
+
+``stage_fn(params, x) -> y`` is the per-stage computation (all stages
+share one program; per-stage behaviour comes from the stacked params).
+``stacked_params`` is a pytree whose leaves have leading dim = number of
+stages (sharded over ``pp``); ``microbatches`` is (num_micro, mb, ...).
+The schedule runs ``num_micro + num_stages - 1`` ticks (the classic GPipe
+fill+drain); outputs are returned replicated.  Differentiable: the whole
+schedule is a ``lax.scan``, so ``jax.grad`` through it yields the 1F1B-
+equivalent backward for free.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh,
+                   axis: str = "pp"):
+    """Run the pipeline; returns (num_micro, mb, ...) outputs.
+
+    Output structure must match the input microbatch structure (stages map
+    activations to activations of the same shape — true for transformer
+    blocks and most residual stages; reshape layers belong inside a stage).
+    """
+    try:
+        from jax import shard_map  # jax >= 0.8: top-level function
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    nstage = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != nstage:
+            raise ValueError(
+                "stacked_params leading dim %d must equal the %r mesh axis "
+                "size %d (one stage per device)" % (leaf.shape[0], axis,
+                                                   nstage))
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + nstage - 1
+    fwd_perm = [(i, (i + 1) % nstage) for i in range(nstage)]
+
+    def per_shard(params_blk, xs):
+        # params_blk leaves have leading dim 1 (this stage); xs is the
+        # full microbatch stream (replicated)
+        params = jax.tree_util.tree_map(lambda p: p[0], params_blk)
+        stage = lax.axis_index(axis)
+        is_first = stage == 0
+        is_last = stage == nstage - 1
+
+        act0 = jnp.zeros_like(xs[0])
+
+        def tick(carry, t):
+            act = carry
+            # stage 0 ingests microbatch t while valid; later stages use
+            # the activation shifted in last tick
+            feed_idx = jnp.minimum(t, n_micro - 1)
+            inp = jnp.where(is_first, xs[feed_idx], act)
+            out = stage_fn(params, inp)
+            # the last stage emits microbatch t-(nstage-1) at this tick;
+            # psum over the ring broadcasts it (other stages contribute 0)
+            emit_valid = (t >= nstage - 1) & is_last
+            emitted = lax.psum(
+                jnp.where(emit_valid, out, jnp.zeros_like(out)), axis)
+            act_next = lax.ppermute(out, axis, fwd_perm)
+            return act_next, emitted
+
+        _, outs = lax.scan(tick, act0, jnp.arange(ticks))
+        return outs[nstage - 1:]          # drop the fill phase
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                P())
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=P())
+    try:
+        fn = shard_map(per_shard, check_vma=False, **kwargs)
+    except TypeError:  # older jax spelling
+        fn = shard_map(per_shard, check_rep=False, **kwargs)
+    return fn(stacked_params, microbatches)

@@ -1,0 +1,100 @@
+"""contrib extras: text vocab/embeddings, tensorboard callback, SVRG
+(reference python/mxnet/contrib/{text,tensorboard,svrg_optimization};
+test strategy: tests/python/unittest/test_contrib_text.py and
+test_contrib_svrg_module.py)."""
+from collections import Counter, namedtuple
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+
+def test_count_tokens_and_vocabulary():
+    counter = text.utils.count_tokens_from_str(
+        "a b b c\nc c d", to_lower=False)
+    assert counter == Counter({"c": 3, "b": 2, "a": 1, "d": 1})
+    vocab = text.Vocabulary(counter, min_freq=2, unknown_token="<unk>",
+                            reserved_tokens=["<pad>"])
+    # <unk>=0, <pad>=1, then c (freq 3), b (freq 2); a/d below min_freq
+    assert len(vocab) == 4
+    assert vocab.to_indices(["c", "b", "zzz"]) == [2, 3, 0]
+    assert vocab.to_tokens([2, 1]) == ["c", "<pad>"]
+    with pytest.raises(ValueError):
+        vocab.to_tokens(99)
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    p = tmp_path / "vecs.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4.0, 5.0, 6.0])
+    # unknown -> zeros
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("nope").asnumpy(), onp.zeros(3))
+    emb.update_token_vectors("hello", mx.nd.array([[9.0, 9.0, 9.0]]))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9.0, 9.0, 9.0])
+
+    vocab = text.Vocabulary(Counter(["hello", "world", "hello"]))
+    comp = text.embedding.CompositeEmbedding(vocab, [emb, emb])
+    assert comp.vec_len == 6
+    got = comp.get_vecs_by_tokens(["hello"]).asnumpy()
+    onp.testing.assert_allclose(got[0], [9.0] * 3 + [9.0] * 3)
+
+
+def test_embedding_registry_and_vocab_restriction(tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("x 1.0 0.0\ny 0.0 1.0\n")
+    emb = text.embedding.create("CustomEmbedding",
+                                pretrained_file_path=str(p),
+                                vocabulary=text.Vocabulary(Counter(["y"])))
+    assert len(emb) == 2          # <unk> + y only
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("y").asnumpy(), [0.0, 1.0])
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+
+
+def test_tensorboard_callback_with_injected_writer():
+    class FakeWriter:
+        def __init__(self):
+            self.rows = []
+
+        def add_scalar(self, tag, value, step):
+            self.rows.append((tag, value, step))
+
+    w = FakeWriter()
+    cb = LogMetricsCallback(summary_writer=w, prefix="train")
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array([1.0, 0.0])],
+             [mx.nd.array([[0.1, 0.9], [0.9, 0.1]])])
+    Param = namedtuple("Param", ["eval_metric"])
+    cb(Param(m))
+    cb(Param(m))
+    assert w.rows[0][0] == "train-accuracy"
+    assert w.rows[0][2] == 1 and w.rows[1][2] == 2
+
+
+def test_svrg_module_trains():
+    from mxnet_tpu import sym, io
+    rs = onp.random.RandomState(0)
+    x = rs.randn(64, 6).astype("float32")
+    y = (x[:, 0] > 0).astype("float32")
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = SVRGModule(net, update_freq=2)
+    train = io.NDArrayIter(x, y, batch_size=16, shuffle=True,
+                           last_batch_handle="discard")
+    metric = mod.fit(train, optimizer_params=(("learning_rate", 0.3),),
+                     num_epoch=8)
+    name, acc = metric.get()
+    assert acc > 0.85, acc
