@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""SSD training example (reference ``example/ssd/train.py`` capability,
+unlocked by the contrib detection ops: MultiBoxPrior/Target/Detection).
+
+Trains a compact SSD — multi-scale conv feature maps, per-scale anchor
+heads — on synthetic detection data.  The full step (forward + SSD loss +
+backward + update) runs eagerly on the device; targets come from
+MultiBoxTarget on the host exactly like the reference's CPU target kernel.
+
+    python example/ssd/train_ssd.py --epochs 2
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class SSDNet(gluon.HybridBlock):
+    """Small SSD: conv body + 2 downsample stages; cls+loc head per scale."""
+
+    def __init__(self, num_classes, anchors_per_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        a = anchors_per_cell
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="body_")
+            with self.body.name_scope():
+                for i, ch in enumerate((32, 64)):
+                    self.body.add(nn.Conv2D(ch, 3, padding=1,
+                                            activation="relu"),
+                                  nn.BatchNorm(),
+                                  nn.MaxPool2D(2))
+            self.down = nn.HybridSequential(prefix="down_")
+            self.cls_heads = []
+            self.loc_heads = []
+            for s in range(3):
+                blk = nn.HybridSequential(prefix="down%d_" % s)
+                if s > 0:
+                    blk.add(nn.Conv2D(64, 3, padding=1, activation="relu"),
+                            nn.MaxPool2D(2))
+                self.down.add(blk)
+                cls = nn.Conv2D((num_classes + 1) * a, 3, padding=1,
+                                prefix="cls%d_" % s)
+                loc = nn.Conv2D(4 * a, 3, padding=1, prefix="loc%d_" % s)
+                self.register_child(cls)
+                self.register_child(loc)
+                self.cls_heads.append(cls)
+                self.loc_heads.append(loc)
+
+    def hybrid_forward(self, F, x):
+        f = self.body(x)
+        cls_outs, loc_outs = [], []
+        for s in range(3):
+            f = self.down[s](f)
+            b = f.shape[0]
+            # (B, A*(C+1), H, W) -> (B, H*W*A, C+1)
+            cls_outs.append(self.cls_heads[s](f).transpose(
+                axes=(0, 2, 3, 1)).reshape(b, -1, self.num_classes + 1))
+            loc_outs.append(self.loc_heads[s](f).transpose(
+                axes=(0, 2, 3, 1)).reshape(b, -1))
+        return F.concat(*cls_outs, dim=1), F.concat(*loc_outs, dim=1)
+
+
+def build_anchors(image_size, sizes_per_scale, ratios):
+    """MultiBoxPrior per feature scale, concatenated (reference
+    symbol_builder multi_layer_feature + anchors)."""
+    anchors = []
+    # matches SSDNet: body pools /4, then each down stage halves again
+    dims = [image_size // 4, image_size // 8, image_size // 16]
+    for s, sizes in enumerate(sizes_per_scale):
+        fm = mx.nd.zeros((1, 1, dims[s], dims[s]))
+        anchors.append(mx.nd.contrib.MultiBoxPrior(
+            fm, sizes=sizes, ratios=ratios))
+    return mx.nd.concat(*anchors, dim=1)
+
+
+def synthetic_batch(rs, batch_size, image_size, num_classes):
+    """One synthetic image batch: a colored box on noise + its gt."""
+    x = rs.rand(batch_size, 3, image_size, image_size).astype("float32")
+    labels = onp.full((batch_size, 1, 5), -1.0, "float32")
+    for b in range(batch_size):
+        cls = rs.randint(0, num_classes)
+        x1, y1 = rs.uniform(0.05, 0.4, 2)
+        x2, y2 = x1 + rs.uniform(0.2, 0.5), y1 + rs.uniform(0.2, 0.5)
+        x2, y2 = min(x2, 0.95), min(y2, 0.95)
+        xi = slice(int(x1 * image_size), int(x2 * image_size))
+        yi = slice(int(y1 * image_size), int(y2 * image_size))
+        x[b, cls % 3, yi, xi] = 1.0
+        labels[b, 0] = [cls, x1, y1, x2, y2]
+    return mx.nd.array(x), mx.nd.array(labels)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batches-per-epoch", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ratios = (1.0, 2.0, 0.5)
+    sizes_per_scale = ((0.2, 0.27), (0.37, 0.45), (0.54, 0.62))
+    a = len(sizes_per_scale[0]) + len(ratios) - 1
+    net = SSDNet(args.num_classes, a)
+    net.initialize(mx.init.Xavier(), ctx=mx.tpu())
+    anchors = build_anchors(args.image_size, sizes_per_scale, ratios)
+    logging.info("anchors: %s", anchors.shape)
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 5e-4})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    huber = gluon.loss.HuberLoss()
+    rs = onp.random.RandomState(args.seed)
+
+    for epoch in range(args.epochs):
+        tic = time.time()
+        epoch_loss = 0.0
+        for _ in range(args.batches_per_epoch):
+            x, labels = synthetic_batch(rs, args.batch_size,
+                                        args.image_size, args.num_classes)
+            x = x.as_in_context(mx.tpu())
+            with autograd.record():
+                cls_pred, loc_pred = net(x)
+                with autograd.pause():
+                    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+                        anchors, labels,
+                        cls_pred.transpose(axes=(0, 2, 1)),
+                        negative_mining_ratio=3.0)
+                # anchors dropped by negative mining carry cls_target=-1
+                # and must be EXCLUDED: mask them out (a -1 label would
+                # wrap to the last class in take_along_axis)
+                cls_mask = (cls_t >= 0).reshape(-1, 1)
+                cls_loss = ce(cls_pred.reshape(-1, args.num_classes + 1),
+                              mx.nd.maximum(cls_t, 0).reshape(-1),
+                              cls_mask)
+                loc_loss = huber(loc_pred * loc_m, loc_t * loc_m)
+                loss = cls_loss.mean() + loc_loss.mean()
+            loss.backward()
+            trainer.step(1)
+            epoch_loss += float(loss.asnumpy())
+        logging.info("epoch %d: loss %.4f (%.1fs)", epoch,
+                     epoch_loss / args.batches_per_epoch,
+                     time.time() - tic)
+
+    # decode detections for one batch (inference path)
+    probs = mx.nd.softmax(cls_pred.transpose(axes=(0, 2, 1)), axis=1)
+    det = mx.nd.contrib.MultiBoxDetection(probs, loc_pred, anchors,
+                                          nms_threshold=0.45)
+    kept = (det.asnumpy()[:, :, 0] >= 0).sum(axis=1)
+    logging.info("detections kept per image: %s", kept[:8].tolist())
+    print("FINAL_LOSS %.4f" % (epoch_loss / args.batches_per_epoch))
+
+
+if __name__ == "__main__":
+    main()

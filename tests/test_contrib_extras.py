@@ -98,3 +98,23 @@ def test_svrg_module_trains():
                      num_epoch=8)
     name, acc = metric.get()
     assert acc > 0.85, acc
+
+
+def test_language_model_dataset(tmp_path):
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+    from mxnet_tpu.gluon.data import DataLoader
+    corpus = tmp_path / "wiki.train.tokens"
+    corpus.write_text("the cat sat on the mat\nthe dog sat too\n" * 20)
+    ds = WikiText2(root=str(tmp_path), segment="train", seq_len=5)
+    assert len(ds) > 10
+    data, label = ds[0]
+    assert data.shape == (5,) and label.shape == (5,)
+    # label is data shifted by one position in the stream
+    d1, _ = ds[1]
+    assert label[-1] == d1[0]
+    dl = DataLoader(ds, batch_size=4, last_batch="discard")
+    batch = next(iter(dl))
+    assert batch[0].shape == (4, 5)
+    # vocabulary roundtrip
+    toks = ds.vocabulary.to_tokens([int(t) for t in data])
+    assert all(isinstance(t, str) for t in toks)

@@ -102,3 +102,18 @@ def test_train_imagenet_benchmark_smoke():
         env=ENV, capture_output=True, text=True, timeout=420)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "benchmark:" in res.stderr or "benchmark:" in res.stdout
+
+
+def test_train_ssd_smoke():
+    """SSD example trains on synthetic data and the loss descends
+    (reference example/ssd/train.py capability)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", "ssd",
+                                      "train_ssd.py"),
+         "--epochs", "3", "--batches-per-epoch", "3", "--batch-size", "8",
+         "--image-size", "64"],
+        env=ENV, capture_output=True, text=True, timeout=480)
+    assert out.returncode == 0, out.stderr[-800:]
+    final = [l for l in out.stdout.splitlines()
+             if l.startswith("FINAL_LOSS")]
+    assert final and float(final[0].split()[1]) < 1.2, out.stdout[-400:]
