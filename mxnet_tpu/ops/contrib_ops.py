@@ -198,3 +198,120 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads: int = 1):
     out = out.reshape(B, heads, Lq, D)
     out = jnp.transpose(out, (2, 0, 1, 3)).reshape(Lq, B, heads * D)
     return out.astype(keys_values.dtype)
+
+
+@register("khatri_rao", aliases=("_contrib_krprod",))
+def khatri_rao(*matrices, num_args: int = 0):
+    """Column-wise Khatri-Rao product (reference contrib/krprod.cc:75):
+    inputs (r_i, c) share the column count; output (prod r_i, c) where
+    each column is the Kronecker product of the input columns."""
+    if not matrices:
+        raise ValueError("khatri_rao needs at least one matrix")
+    out = matrices[0]
+    for m in matrices[1:]:
+        # (R, c) ⊗col (r, c) -> (R*r, c)
+        out = (out[:, None, :] * m[None, :, :]).reshape(
+            out.shape[0] * m.shape[0], m.shape[1])
+    return out
+
+
+@register("_contrib_arange_like", aliases=("arange_like",),
+          differentiable=False)
+def arange_like(data, start: float = 0.0, step: float = 1.0, repeat: int = 1,
+                axis=None):
+    """arange shaped like ``data`` (reference contrib tensor op) — handy
+    for position ids without dynamic shapes.  ``repeat`` duplicates each
+    value that many consecutive times, like nd.arange."""
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+    else:
+        n = data.shape[axis]
+    count = -(-n // max(repeat, 1))
+    seq = start + step * jnp.arange(count, dtype=jnp.float32)
+    if repeat > 1:
+        seq = jnp.repeat(seq, repeat)[:n]
+    if axis is None:
+        return seq.reshape(data.shape)
+    return seq
+
+
+@register("_contrib_allclose", aliases=("allclose",), differentiable=False)
+def allclose(a, b, rtol: float = 1e-5, atol: float = 1e-8,
+             equal_nan: bool = False):
+    """1.0 iff allclose (reference contrib/allclose_op.cc); shape (1,)."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32).reshape(1)
+
+
+@register("_contrib_boolean_mask", aliases=("boolean_mask",),
+          differentiable=False)
+def boolean_mask(data, index, axis: int = 0):
+    """Select slices where ``index`` is nonzero (reference
+    contrib/boolean_mask.cc:198).
+
+    TPU note: the output shape depends on the DATA — XLA requires static
+    shapes, so this op is EAGER-ONLY (the reference groups it with the
+    dynamic-shape ops that likewise bypass the static executor).  Inside
+    jit, use ``jnp.where``-style masking instead.
+    """
+    import jax.core as _jcore
+    if isinstance(data, _jcore.Tracer) or isinstance(index, _jcore.Tracer):
+        raise ValueError(
+            "boolean_mask has a data-dependent output shape and cannot run "
+            "under jit on TPU; mask with where() or run it eagerly")
+    import numpy as onp
+    keep = onp.asarray(index) != 0
+    if keep.shape[0] != data.shape[axis]:
+        raise ValueError(
+            "boolean_mask: index length %d must equal data.shape[%d]=%d "
+            "(the reference rejects this at shape inference)"
+            % (keep.shape[0], axis, data.shape[axis]))
+    return jnp.asarray(onp.compress(keep, onp.asarray(data), axis=axis))
+
+
+@register("_contrib_hawkesll", aliases=("hawkesll",), num_outputs=2)
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Univariate Hawkes process log-likelihood (reference
+    contrib/hawkes_ll.cc:32).
+
+    lda (N,K) background intensities, alpha/beta (K,) branching/decay,
+    state (N,K) carried memory, lags/marks (N,T) ragged left-aligned
+    observations, valid_length (N,), max_time (N,) → (loglik (N,),
+    out_state (N,K)).  One ``lax.scan`` over the sequence — jit-friendly,
+    differentiable by autodiff (the reference hand-writes the backward).
+    """
+    N, K = lda.shape
+    T = lags.shape[1]
+    marks_i = marks.astype(jnp.int32)
+    vl = valid_length.astype(jnp.int32)
+
+    def step(carry, j):
+        t, last, st, ll = carry
+        valid = (j < vl).astype(lda.dtype)            # (N,)
+        ci = marks_i[:, j]                            # (N,)
+        onehot = jax.nn.one_hot(ci, K, dtype=lda.dtype)
+        t_new = t + lags[:, j] * valid
+        gather = lambda m: jnp.take_along_axis(m, ci[:, None], 1)[:, 0]
+        d = t_new - gather(last)
+        b_ci = beta[ci]
+        ed = jnp.exp(-b_ci * d)
+        lam = gather(lda) + alpha[ci] * b_ci * gather(st) * ed
+        comp = gather(lda) * d + alpha[ci] * gather(st) * (1.0 - ed)
+        ll = ll + valid * (jnp.log(lam) - comp)
+        new_rows = 1.0 + gather(st) * ed
+        st = st + onehot * ((new_rows - gather(st)) * valid)[:, None]
+        last = last + onehot * ((t_new - gather(last)) * valid)[:, None]
+        return (t_new, last, st, ll), None
+
+    t0 = jnp.zeros((N,), lda.dtype)
+    last0 = jnp.zeros((N, K), lda.dtype)
+    ll0 = jnp.zeros((N,), lda.dtype)
+    (t, last, st, ll), _ = lax.scan(step, (t0, last0, state, ll0),
+                                    jnp.arange(T))
+    # remaining compensator over (last event, max_time]
+    d = max_time[:, None] - last                      # (N,K)
+    ed = jnp.exp(-beta[None, :] * d)
+    rem = lda * d + alpha[None, :] * st * (1.0 - ed)
+    return ll - rem.sum(axis=1), st * ed

@@ -272,16 +272,6 @@ def histogram(data, bin_cnt=None, range=None):
     return h.astype(jnp.float32), edges
 
 
-@register("boolean_mask", differentiable=False)
-def boolean_mask(data, index, axis: int = 0):
-    # dynamic shape in the reference (contrib/boolean_mask); on TPU we keep
-    # static shapes: compress via sort trick is out of scope — fall back to
-    # host computation (matches reference capability; not jittable).
-    import numpy as onp
-    mask = onp.asarray(index) != 0
-    return jnp.compress(mask, data, axis=axis)
-
-
 # --- linalg (reference la_op / linalg_impl.h → jnp.linalg) -----------------
 @register("linalg_gemm")
 def linalg_gemm(A, B, C, transpose_a: bool = False, transpose_b: bool = False,

@@ -118,3 +118,81 @@ def test_language_model_dataset(tmp_path):
     # vocabulary roundtrip
     toks = ds.vocabulary.to_tokens([int(t) for t in data])
     assert all(isinstance(t, str) for t in toks)
+
+
+def test_khatri_rao_matches_numpy():
+    a = onp.arange(6, dtype="float32").reshape(3, 2)
+    b = onp.arange(8, dtype="float32").reshape(4, 2) + 1
+    out = mx.nd.khatri_rao(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    want = onp.stack([onp.kron(a[:, c], b[:, c]) for c in range(2)], 1)
+    onp.testing.assert_allclose(out, want)
+
+
+def test_arange_like_allclose_boolean_mask():
+    x = mx.nd.zeros((2, 3))
+    onp.testing.assert_allclose(
+        mx.nd.contrib.arange_like(x).asnumpy(),
+        onp.arange(6, dtype="float32").reshape(2, 3))
+    onp.testing.assert_allclose(
+        mx.nd.contrib.arange_like(x, start=2, step=0.5, axis=1).asnumpy(),
+        [2.0, 2.5, 3.0])
+    assert mx.nd.contrib.allclose(
+        mx.nd.ones((3,)), mx.nd.ones((3,)) + 1e-9).asnumpy().item() == 1.0
+    data = onp.arange(12, dtype="float32").reshape(4, 3)
+    got = mx.nd.contrib.boolean_mask(
+        mx.nd.array(data), mx.nd.array([1.0, 0.0, 1.0, 0.0])).asnumpy()
+    onp.testing.assert_allclose(got, data[[0, 2]])
+
+
+def test_hawkesll_matches_reference_recursion():
+    """Oracle: direct python transcription of the reference kernel loop
+    (hawkes_ll-inl.h:113 forward + :163 compensator)."""
+    rs = onp.random.RandomState(0)
+    N, K, T = 2, 3, 5
+    lda = rs.uniform(0.5, 1.5, (N, K)).astype("float32")
+    alpha = rs.uniform(0.1, 0.4, (K,)).astype("float32")
+    beta = rs.uniform(0.5, 2.0, (K,)).astype("float32")
+    state = rs.uniform(0, 1, (N, K)).astype("float32")
+    lags = rs.uniform(0.1, 0.5, (N, T)).astype("float32")
+    marks = rs.randint(0, K, (N, T)).astype("float32")
+    vl = onp.array([5, 3], "float32")
+    mt = onp.array([4.0, 3.0], "float32")
+
+    ll_ref = onp.zeros(N, "float32")
+    st_ref = state.copy()
+    for i in range(N):
+        t = 0.0
+        last = onp.zeros(K, "float32")
+        for j in range(int(vl[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[ci]
+            ed = onp.exp(-beta[ci] * d)
+            lam = lda[i, ci] + alpha[ci] * beta[ci] * st_ref[i, ci] * ed
+            comp = lda[i, ci] * d + alpha[ci] * st_ref[i, ci] * (1 - ed)
+            ll_ref[i] += onp.log(lam) - comp
+            st_ref[i, ci] = 1 + st_ref[i, ci] * ed
+            last[ci] = t
+        for k in range(K):
+            d = mt[i] - last[k]
+            ed = onp.exp(-beta[k] * d)
+            ll_ref[i] -= lda[i, k] * d + alpha[k] * st_ref[i, k] * (1 - ed)
+            st_ref[i, k] *= ed
+
+    ll, st = mx.nd.contrib.hawkesll(
+        mx.nd.array(lda), mx.nd.array(alpha), mx.nd.array(beta),
+        mx.nd.array(state), mx.nd.array(lags), mx.nd.array(marks),
+        mx.nd.array(vl), mx.nd.array(mt))
+    onp.testing.assert_allclose(ll.asnumpy(), ll_ref, rtol=1e-4)
+    onp.testing.assert_allclose(st.asnumpy(), st_ref, rtol=1e-4)
+
+
+
+def test_arange_like_repeat_and_boolean_mask_mismatch():
+    x = mx.nd.zeros((6,))
+    onp.testing.assert_allclose(
+        mx.nd.contrib.arange_like(x, repeat=2).asnumpy(),
+        [0.0, 0.0, 1.0, 1.0, 2.0, 2.0])
+    with pytest.raises(Exception):
+        mx.nd.contrib.boolean_mask(mx.nd.zeros((4, 3)),
+                                   mx.nd.array([1.0, 0.0]))

@@ -637,6 +637,12 @@ COVERED_ELSEWHERE = {
     "flash_attention", "_contrib_flash_attention",
     # tested in tests/test_custom_op.py (imperative/gluon/module paths)
     "Custom", "custom",
+    # tested in tests/test_contrib_extras.py (numpy-oracle checks)
+    "khatri_rao", "_contrib_krprod",
+    "_contrib_arange_like", "arange_like",
+    "_contrib_allclose", "allclose",
+    "_contrib_boolean_mask", "boolean_mask",
+    "_contrib_hawkesll", "hawkesll",
     # tested in tests/test_detection_ops.py (value + SSD training checks)
     "_contrib_MultiBoxTarget", "MultiBoxTarget",
     "_contrib_MultiBoxDetection", "MultiBoxDetection",
@@ -678,7 +684,7 @@ COVERED_ELSEWHERE = {
     "reverse", "flip", "swapaxes", "transpose", "squeeze", "expand_dims",
     "slice", "slice_axis", "tile", "repeat", "clip", "broadcast_to",
     "broadcast_like", "take", "pick", "one_hot", "gather_nd", "diag",
-    "tril", "sort", "argsort", "argmax", "argmin", "boolean_mask",
+    "tril", "sort", "argsort", "argmax", "argmin",
     "where", "dot", "batch_dot", "linalg_det", "linalg_gemm",
     "linalg_gemm2", "linalg_inverse", "linalg_potrf", "max_axis",
     "min_axis", "sum_axis", "log_softmax", "softmin", "softmax",
