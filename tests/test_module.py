@@ -168,3 +168,51 @@ def test_module_reshape_on_batch_change():
             label=[mx.nd.array(onp.zeros(bs, onp.float32))])
         mod.forward(batch, is_train=False)
         assert mod.get_outputs()[0].shape == (bs, 4)
+
+
+def test_sequential_module_trains():
+    """SequentialModule chains two Modules; gradients flow across the
+    seam and the composite trains (reference test_module.py
+    test_module_layout / sequential tests)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, io
+    from mxnet_tpu.module import SequentialModule
+
+    rs = onp.random.RandomState(0)
+    x = rs.randn(64, 6).astype("float32")
+    y = (x[:, 0] * x[:, 1] > 0).astype("float32")
+
+    d1 = sym.var("data")
+    net1 = sym.FullyConnected(d1, num_hidden=16, name="m1fc")
+    net1 = sym.Activation(net1, act_type="tanh")
+
+    d2 = sym.var("m1_out")
+    net2 = sym.FullyConnected(d2, num_hidden=2, name="m2fc")
+    net2 = sym.SoftmaxOutput(net2, name="softmax")
+
+    seq = SequentialModule()
+    seq.add(mx.mod.Module(net1, data_names=["data"], label_names=None))
+    seq.add(mx.mod.Module(net2, data_names=["m1_out"],
+                          label_names=["softmax_label"]),
+            take_labels=True, auto_wiring=True)
+
+    train = io.NDArrayIter(x, y, batch_size=16, shuffle=True,
+                           last_batch_handle="discard")
+    seq.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.3),))
+    m = mx.metric.Accuracy()
+    for _ in range(12):
+        train.reset()
+        m.reset()
+        for batch in train:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(m, batch.label)
+    assert m.get()[1] > 0.8, m.get()
+    # composite params gather from both children
+    arg, _ = seq.get_params()
+    assert "m1fc_weight" in arg and "m2fc_weight" in arg
