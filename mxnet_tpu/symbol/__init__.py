@@ -216,3 +216,6 @@ def __getattr__(name):
 
 def __dir__():
     return sorted(set(list(globals().keys()) + list_ops()))
+
+
+from . import contrib  # noqa: F401,E402  (namespace, mirrors mx.nd.contrib)

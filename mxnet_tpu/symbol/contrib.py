@@ -1,0 +1,15 @@
+"""``mx.sym.contrib`` namespace (reference
+``python/mxnet/symbol/contrib.py``): forwards to the registry's
+``_contrib_*`` operators (or their bare aliases) as symbol builders."""
+from __future__ import annotations
+
+
+def __getattr__(name):
+    from . import __getattr__ as _sym_getattr
+    for candidate in ("_contrib_" + name, name):
+        try:
+            return _sym_getattr(candidate)
+        except AttributeError:
+            continue
+    raise AttributeError("module 'symbol.contrib' has no attribute %r"
+                         % name)

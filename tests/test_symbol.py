@@ -167,3 +167,18 @@ def test_fluent_and_scalar_ops():
     exe.forward()
     onp.testing.assert_allclose(exe.outputs[0].asnumpy(),
                                 onp.arange(4).reshape(2, 2) + 1.0)
+
+
+def test_sym_contrib_namespace():
+    """mx.sym.contrib.* forwards to _contrib_ registry ops (reference
+    python/mxnet/symbol/contrib.py codegen)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    s = sym.contrib.MultiBoxPrior(sym.var("data"), sizes=(0.2, 0.4),
+                                  ratios=(1.0,))
+    out = s.eval_imperative({"data": mx.nd.zeros((1, 3, 4, 4))})
+    assert out.shape == (1, 4 * 4 * 2, 4)
+    d = sym.contrib.div_sqrt_dim(sym.var("x"))
+    got = d.eval_imperative({"x": mx.nd.ones((2, 16))}).asnumpy()
+    onp.testing.assert_allclose(got, onp.full((2, 16), 0.25), rtol=1e-6)
