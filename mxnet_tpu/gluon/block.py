@@ -226,15 +226,37 @@ class Block:
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):
         from ..ndarray.utils import load as nd_load
         loaded = nd_load(filename)
+        if not isinstance(loaded, dict):
+            raise ValueError(
+                "load_parameters needs a name->NDArray dict file; %r "
+                "contains an unnamed array list" % (filename,))
+        # Module/export-style checkpoints tag names with arg:/aux:
+        # (reference load_parameters strips them the same way)
+        if loaded and any(k.startswith(("arg:", "aux:")) for k in loaded):
+            loaded = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                      else k: v for k, v in loaded.items()}
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return
         # accept both structural and prefixed formats (reference does the same)
         if loaded and not any("." in k for k in loaded.keys()) \
                 and any("." in k for k in params.keys()):
-            # prefixed format → route through collect_params
-            self.collect_params().load(
-                filename, ctx, allow_missing, ignore_extra, self.prefix)
+            # prefixed format → match against the full parameter names,
+            # keeping the arg:/aux: strip applied above
+            full = self.collect_params()
+            renamed = {self.prefix + k: v for k, v in loaded.items()}
+            if not allow_missing:
+                for name in full.keys():
+                    assert name in renamed, \
+                        "Parameter '%s' is missing in file '%s'" % (
+                            name[len(self.prefix):], filename)
+            for name, value in renamed.items():
+                if name not in full.keys():
+                    assert ignore_extra, \
+                        "Parameter '%s' loaded from file '%s' is not " \
+                        "present in this Block" % (name, filename)
+                    continue
+                full[name]._load_init(value, ctx)
             return
         if not allow_missing:
             for name in params.keys():

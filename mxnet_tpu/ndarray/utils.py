@@ -47,7 +47,16 @@ def save(fname: str, data) -> None:
 
 
 def load(fname: str, ctx=None) -> Union[List[NDArray], Dict[str, NDArray]]:
-    """Load from ``save`` (reference nd.load)."""
+    """Load from ``save`` (reference nd.load).
+
+    Auto-detects the upstream binary format (magic 0x112) so real MXNet
+    ``.params`` checkpoints load transparently (ndarray/legacy_io.py)."""
+    from . import legacy_io
+    if legacy_io.is_legacy_file(fname):
+        raw = legacy_io.load_legacy(fname)
+        if isinstance(raw, dict):
+            return {k: array(v, ctx=ctx) for k, v in raw.items()}
+        return [array(v, ctx=ctx) for v in raw]
     with onp.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
         if keys and all(k.startswith(_LIST_PREFIX) for k in keys):

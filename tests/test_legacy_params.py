@@ -1,0 +1,157 @@
+"""Upstream binary .params format interop (reference
+src/ndarray/ndarray.cc:1600 Save / :1826 list container): files written
+in the reference's exact byte layout load through plain nd.load, and
+save_legacy round-trips — so published MXNet checkpoints are usable."""
+import struct
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import legacy_io
+
+
+def _write_reference_bytes(fname, named):
+    """Independent writer following src/ndarray/ndarray.cc byte-for-byte
+    (separate from save_legacy so the test is not self-confirming)."""
+    out = [struct.pack("<QQ", 0x112, 0), struct.pack("<Q", len(named))]
+    for _, a in named:
+        out += [struct.pack("<I", 0xF993FAC9),       # NDARRAY_V2_MAGIC
+                struct.pack("<i", 0),                # kDefaultStorage
+                struct.pack("<i", a.ndim),
+                struct.pack("<%dq" % a.ndim, *a.shape),
+                struct.pack("<ii", 1, 0),            # Context{kCPU, 0}
+                struct.pack("<i", {onp.dtype("float32"): 0,
+                                   onp.dtype("int64"): 6,
+                                   onp.dtype("uint8"): 3}[a.dtype]),
+                a.tobytes()]
+    out.append(struct.pack("<Q", len(named)))
+    for n, _ in named:
+        raw = n.encode()
+        out += [struct.pack("<Q", len(raw)), raw]
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+def test_reference_format_loads_via_nd_load(tmp_path):
+    rs = onp.random.RandomState(0)
+    named = [("arg:fc1_weight", rs.randn(4, 3).astype("float32")),
+             ("arg:fc1_bias", rs.randn(4).astype("float32")),
+             ("aux:ids", onp.arange(5, dtype="int64")),
+             ("img", rs.randint(0, 255, (2, 2), dtype=onp.uint8))]
+    path = str(tmp_path / "model-0000.params")
+    _write_reference_bytes(path, named)
+    assert legacy_io.is_legacy_file(path)
+    loaded = mx.nd.load(path)
+    assert set(loaded) == {n for n, _ in named}
+    for n, a in named:
+        onp.testing.assert_array_equal(loaded[n].asnumpy(), a)
+        if a.dtype.itemsize < 8:   # 64-bit narrows (jax x64-off policy)
+            assert loaded[n].dtype == a.dtype
+
+
+def test_reference_format_unnamed_list(tmp_path):
+    a = onp.arange(6, dtype="float32").reshape(2, 3)
+    path = str(tmp_path / "plain.nd")
+    out = [struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+           struct.pack("<I", 0xF993FAC9), struct.pack("<i", 0),
+           struct.pack("<i", 2), struct.pack("<qq", 2, 3),
+           struct.pack("<ii", 1, 0), struct.pack("<i", 0), a.tobytes(),
+           struct.pack("<Q", 0)]
+    with open(path, "wb") as f:
+        f.write(b"".join(out))
+    loaded = mx.nd.load(path)
+    assert isinstance(loaded, list) and len(loaded) == 1
+    onp.testing.assert_array_equal(loaded[0].asnumpy(), a)
+
+
+def test_save_legacy_roundtrip(tmp_path):
+    rs = onp.random.RandomState(1)
+    d = {"w": mx.nd.array(rs.randn(3, 5).astype("float32")),
+         "b": mx.nd.array(rs.randn(5).astype("float32"))}
+    path = str(tmp_path / "out.params")
+    legacy_io.save_legacy(path, d)
+    back = mx.nd.load(path)
+    for k in d:
+        onp.testing.assert_allclose(back[k].asnumpy(), d[k].asnumpy())
+
+
+def test_gluon_params_from_reference_format(tmp_path):
+    """A reference-format checkpoint feeds load_parameters end to end."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(3, in_units=4, prefix="dense0_")
+    net.initialize()
+    w = onp.random.RandomState(2).randn(3, 4).astype("float32")
+    b = onp.zeros(3, "float32")
+    path = str(tmp_path / "net-0000.params")
+    # gluon save_parameters uses structural names ("weight"/"bias")
+    _write_reference_bytes(path, [("weight", w), ("bias", b)])
+    net.load_parameters(path)
+    x = onp.ones((2, 4), "float32")
+    onp.testing.assert_allclose(net(mx.nd.array(x)).asnumpy(), x @ w.T,
+                                rtol=1e-5)
+
+
+def test_gluon_load_strips_arg_aux_prefixes(tmp_path):
+    """Module-export-style names (arg:/aux:) load into gluon blocks
+    (reference load_parameters strips them)."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    w = onp.random.RandomState(3).randn(2, 3).astype("float32")
+    b = onp.ones(2, "float32")
+    path = str(tmp_path / "mod-0000.params")
+    _write_reference_bytes(path, [("arg:weight", w), ("arg:bias", b)])
+    net.load_parameters(path)
+    x = onp.ones((1, 3), "float32")
+    onp.testing.assert_allclose(net(mx.nd.array(x)).asnumpy(),
+                                x @ w.T + b, rtol=1e-5)
+
+
+def test_v1_and_v3_magics_parse():
+    """V1 (no stype field) and V3 (np-shape) entries parse correctly —
+    the three version magics must not be confused."""
+    import io as _io
+    for magic, has_stype in ((0xF993FAC8, False), (0xF993FACA, True)):
+        a = onp.arange(4, dtype="float32")
+        chunks = [struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+                  struct.pack("<I", magic)]
+        if has_stype:
+            chunks.append(struct.pack("<i", 0))
+        chunks += [struct.pack("<i", 1), struct.pack("<q", 4),
+                   struct.pack("<ii", 1, 0), struct.pack("<i", 0),
+                   a.tobytes(), struct.pack("<Q", 0)]
+        import tempfile, os
+        d = tempfile.mkdtemp()
+        p = os.path.join(d, "x.nd")
+        with open(p, "wb") as f:
+            f.write(b"".join(chunks))
+        out = legacy_io.load_legacy(p)
+        onp.testing.assert_array_equal(out[0], a)
+
+
+def test_save_legacy_rejects_scalars(tmp_path):
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        legacy_io.save_legacy(str(tmp_path / "s.nd"),
+                              {"x": onp.float32(3.0).reshape(())})
+
+
+def test_prefixed_format_with_arg_tags(tmp_path):
+    """arg:-tagged prefixed names load into a multi-child block."""
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Dense(3, in_units=4))
+    net.initialize()
+    inner_prefix = net[0].prefix[len(net.prefix):]
+    w = onp.random.RandomState(4).randn(3, 4).astype("float32")
+    b = onp.zeros(3, "float32")
+    path = str(tmp_path / "m-0000.params")
+    _write_reference_bytes(path, [
+        ("arg:%sweight" % inner_prefix, w),
+        ("arg:%sbias" % inner_prefix, b)])
+    net.load_parameters(path)
+    x = onp.ones((2, 4), "float32")
+    onp.testing.assert_allclose(net(mx.nd.array(x)).asnumpy(), x @ w.T,
+                                rtol=1e-5)
