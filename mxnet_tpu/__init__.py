@@ -70,6 +70,7 @@ from . import visualization
 from . import visualization as viz
 from . import runtime
 from . import engine
+from . import subgraph
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray

@@ -12,8 +12,8 @@ Layers are batch-major (batch, seq, units), Gluon convention.
 Attention-probability dropout is applied to the attention *output* when
 the flash path is active (the fused kernel never materializes the
 probability matrix — the approximation every flash implementation makes).
-An explicit additive ``mask`` forces the dense path, since the kernel
-supports only causal/none masking.
+Padding masks (``valid_length``) run inside the flash kernel's online
+softmax; only an arbitrary additive ``mask`` forces the dense path.
 """
 from __future__ import annotations
 
@@ -56,19 +56,31 @@ class MultiHeadAttention(HybridBlock):
         d = self._units // self._heads
         return x.reshape(b, l, self._heads, d).transpose(axes=(0, 2, 1, 3))
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         b, l = x.shape[0], x.shape[1]
         qkv = self.qkv(x)                          # (B, L, 3E)
         q, k, v = (self._heads_split(part)
                    for part in F.split(qkv, num_outputs=3, axis=-1))
         if mask is None:
-            out = F.flash_attention(q, k, v, causal=self._causal)
+            # padding masks (per-row valid length) run INSIDE the flash
+            # kernel — masked inside the online softmax, fully-masked key
+            # blocks skipped — so padded batches (the normal BERT case)
+            # keep the fused path
+            out = F.flash_attention(q, k, v, kv_lens=valid_length,
+                                    causal=self._causal)
         else:
             d = self._units // self._heads
             scores = F.batch_dot(q.reshape(-1, l, d),
                                  k.reshape(-1, l, d),
                                  transpose_b=True) / (d ** 0.5)
             scores = scores.reshape(b, self._heads, l, l) + mask
+            if valid_length is not None:
+                # both given: fold the padding mask into the additive mask
+                # (keys at/after the row's valid length score -inf)
+                col = F.arange(0, l).reshape(1, 1, 1, l)
+                vl = valid_length.astype("float32").reshape(-1, 1, 1, 1)
+                scores = scores + \
+                    F.broadcast_greater_equal(col, vl) * -1e30
             probs = F.softmax(scores, axis=-1)
             out = F.batch_dot(probs.reshape(-1, l, l), v.reshape(-1, l, d))
             out = out.reshape(b, self._heads, l, d)
@@ -117,8 +129,8 @@ class TransformerEncoderCell(HybridBlock):
             self.ffn_norm = LayerNorm(epsilon=layer_norm_eps,
                                       prefix="ffn_ln_")
 
-    def hybrid_forward(self, F, x, mask=None):
-        x = self.attn_norm(x + self.attention(x, mask))
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
+        x = self.attn_norm(x + self.attention(x, mask, valid_length))
         return self.ffn_norm(x + self.ffn(x))
 
 
@@ -137,7 +149,7 @@ class TransformerEncoder(HybridBlock):
                 self.register_child(cell)
                 self.cells.append(cell)
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         for cell in self.cells:
-            x = cell(x, mask)
+            x = cell(x, mask, valid_length)
         return x

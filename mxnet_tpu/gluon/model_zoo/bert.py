@@ -57,7 +57,8 @@ class BERTModel(HybridBlock):
                 self.decoder = Dense(vocab_size, flatten=False,
                                      prefix="decoder_out_")
 
-    def hybrid_forward(self, F, token_ids, token_types=None, mask=None):
+    def hybrid_forward(self, F, token_ids, token_types=None, mask=None,
+                       valid_length=None):
         seq_len = token_ids.shape[1]
         positions = F.arange(0, seq_len).reshape(1, seq_len)
         x = self.word_embed(token_ids) + self.pos_embed(positions)
@@ -66,7 +67,7 @@ class BERTModel(HybridBlock):
         x = self.embed_norm(x)
         if self.embed_drop is not None:
             x = self.embed_drop(x)
-        seq = self.encoder(x, mask)
+        seq = self.encoder(x, mask, valid_length)
         outs = [seq]
         if self._use_pooler:
             outs.append(self.pooler(F.slice_axis(seq, axis=1, begin=0,

@@ -18,6 +18,12 @@ from jax import lax
 
 from .registry import register, alias
 
+try:
+    from jax.ad_checkpoint import checkpoint_name as _remat_name
+except ImportError:  # older jax: names unused, identity keeps semantics
+    def _remat_name(x, name):
+        return x
+
 
 # ---------------------------------------------------------------------------
 # dense / conv
@@ -70,6 +76,7 @@ def convolution(data, weight, bias=None, kernel=(), stride=None, dilate=None,
         dimension_numbers=_conv_dn(n),
         feature_group_count=num_group,
     )
+    out = _remat_name(out, "conv_out")
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out
@@ -234,8 +241,10 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var,
         # clamp: fp32 cancellation on a large-mean/low-variance channel can
         # drive E[x²]−E[x]² slightly negative → rsqrt NaN
         var = jnp.maximum(sq - jnp.square(mean), 0.0)
-        mean = mean.astype(data.dtype)
-        var = var.astype(data.dtype)
+        # under backward-mirror remat the (tiny) per-channel stats are saved
+        # so the bwd recompute never re-reduces the big activation tensor
+        mean = _remat_name(mean.astype(data.dtype), "bn_stats")
+        var = _remat_name(var.astype(data.dtype), "bn_stats")
     return _bn_apply(data, mean, var, gamma, beta, eps, fix_gamma, axis)
 
 

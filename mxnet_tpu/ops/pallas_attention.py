@@ -43,12 +43,22 @@ _LANES = 128
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, block_q, block_k, seq_k, n_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
+                seq_k, n_k, has_lens, has_seg):
     import jax.experimental.pallas as pl
 
+    rest = list(rest)
+    lens_ref = rest.pop(0) if has_lens else None
+    qseg_ref = rest.pop(0) if has_seg else None
+    kseg_ref = rest.pop(0) if has_seg else None
+    o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    # lens rides in SMEM as ONE whole-array block (Mosaic requires SMEM
+    # blocks be full-dim or (8,128)-tiled); index by the grid's batch coord
+    kvlen = lens_ref[bi, 0] if has_lens else None
 
     @pl.when(ki == 0)
     def _init():
@@ -63,14 +73,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
 
-        # mask: padded K tail, plus causal upper triangle
+        # mask: padded K tail, plus causal upper triangle, plus the
+        # variable-length / segment masks when present
         col = ki * block_k + lax.broadcasted_iota(jnp.int32,
                                                   (block_q, block_k), 1)
-        mask = col < seq_k
+        mask = col < (kvlen if has_lens else seq_k)
         if causal:
             row = qi * block_q + lax.broadcasted_iota(jnp.int32,
                                                       (block_q, block_k), 0)
             mask = mask & (row >= col)
+        if has_seg:
+            mask = mask & (qseg_ref[0] == kseg_ref[0])  # (bq,1)==(1,bk)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[...][:, :1]         # (block_q, 1); lanes replicated
@@ -78,7 +91,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
         corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # explicit zero on masked entries: in a fully-masked row m_new is
+        # itself _NEG_INF, so exp(s - m_new) would be exp(0)=1 — the row
+        # must instead stay empty (l==0 → out 0, lse pinned)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -86,12 +102,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
+    run = True
     if causal:
         # skip blocks entirely above the diagonal
         run = (qi * block_q + block_q - 1) >= (ki * block_k)
-        pl.when(run)(_compute)
-    else:
+    if has_lens:
+        # skip K blocks entirely past this batch row's valid length
+        run = run & (ki * block_k < kvlen)
+    if run is True:
         _compute()
+    else:
+        pl.when(run)(_compute)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -119,29 +140,81 @@ def _pad_qkv(q, k, v, block_q, block_k):
             vp.reshape(B * H, Tkp, Dp), Tqp, Tkp, Dp)
 
 
+def _expand_mask_operands(kv_lens, q_segments, kv_segments, B, H, Tqp, Tkp,
+                          transposed=False):
+    """Broadcast per-batch mask operands over heads into the kernels'
+    (B*H, …) layouts: lens (BH, 1) int32, and segment ids shaped so they
+    broadcast against the score block each kernel works on — forward
+    scores (block_q, block_k): q as (BH, Tqp, 1) columns, kv as
+    (BH, 1, Tkp) rows; ``transposed`` (backward, score blocks are
+    (block_k, block_q)): q rows / kv columns.  q/kv padding positions get
+    distinct sentinels (-1 / -2) so they never match anything."""
+    lens = qs = ks = None
+    if kv_lens is not None:
+        lens = jnp.broadcast_to(
+            kv_lens.astype(jnp.int32)[:, None], (B, H)).reshape(B * H, 1)
+    if q_segments is not None:
+        Tq = q_segments.shape[1]
+        qs = jnp.pad(q_segments.astype(jnp.int32), ((0, 0), (0, Tqp - Tq)),
+                     constant_values=-1)
+        qs = jnp.broadcast_to(qs[:, None, :], (B, H, Tqp)).reshape(
+            (B * H, 1, Tqp) if transposed else (B * H, Tqp, 1))
+        Tk = kv_segments.shape[1]
+        ks = jnp.pad(kv_segments.astype(jnp.int32), ((0, 0), (0, Tkp - Tk)),
+                     constant_values=-2)
+        ks = jnp.broadcast_to(ks[:, None, :], (B, H, Tkp)).reshape(
+            (B * H, Tkp, 1) if transposed else (B * H, 1, Tkp))
+    return lens, qs, ks
+
+
 def pallas_flash_attention(q, k, v, causal=False, scale=None,
                            block_q: int = 1024, block_k: int = 2048,
-                           interpret: bool = False, return_lse: bool = False):
+                           interpret: bool = False, return_lse: bool = False,
+                           kv_lens=None, q_segments=None, kv_segments=None):
     # Defaults tuned on a v5e chip (S=2048, D=64 fwd+bwd sweep): (1024, 2048)
     # sustains ~61 TF/s vs ~35 TF/s for XLA dense attention; blocks are
     # capped at the sequence length so short inputs degrade gracefully.
-    """Raw kernel entry: q/k/v (B, H, T, D) → (B, H, Tq, D) [, lse]."""
+    """Raw kernel entry: q/k/v (B, H, T, D) → (B, H, Tq, D) [, lse].
+
+    ``kv_lens`` (B,) int masks keys at/after the per-row valid length —
+    K blocks wholly past it are skipped, the partial block is masked
+    inside the online softmax.  ``q_segments``/``kv_segments`` (B, T) int
+    ids restrict attention to equal segments (packed-sequence masking,
+    ref transformer.cc's masked softmax).  Fully-masked rows emit 0."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else D ** -0.5
+    if (q_segments is None) != (kv_segments is None):
+        raise ValueError("q_segments and kv_segments go together")
 
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
     qp, kp, vp, Tqp, Tkp, Dp = _pad_qkv(q, k, v, block_q, block_k)
     n_q = Tqp // block_q
     n_k = Tkp // block_k
+    lens, qs, ks = _expand_mask_operands(kv_lens, q_segments, kv_segments,
+                                         B, H, Tqp, Tkp)
+
+    extra, extra_specs = [], []
+    if lens is not None:
+        extra.append(lens)
+        extra_specs.append(pl.BlockSpec(
+            lens.shape, lambda b, qi, ki: (0, 0),
+            memory_space=pltpu.SMEM))
+    if qs is not None:
+        extra += [qs, ks]
+        extra_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, qi, ki: (b, 0, ki)),
+        ]
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=Tk, n_k=n_k)
+        block_k=block_k, seq_k=Tk, n_k=n_k, has_lens=lens is not None,
+        has_seg=qs is not None)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, n_q, n_k),
@@ -149,7 +222,7 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
             pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        ] + extra_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -166,7 +239,7 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp)
+    )(qp, kp, vp, *extra)
     out = out.reshape(B, H, Tqp, Dp)[:, :, :Tq, :D]
     if return_lse:
         return out, lse.reshape(B, H, Tqp)[:, :, :Tq]
@@ -177,27 +250,43 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
 # backward
 # ---------------------------------------------------------------------------
 
-def _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k, seq_k, causal):
+def _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k, seq_k, causal,
+              kvlen=None, qseg_row=None, kseg_col=None):
     """Recomputed transposed probability block pᵀ (block_k, block_q)."""
     sT = lax.dot_general(k, q, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32) * scale
     kcol = ki * block_k + lax.broadcasted_iota(jnp.int32,
                                                (block_k, block_q), 0)
-    mask = kcol < seq_k
+    mask = kcol < (seq_k if kvlen is None else kvlen)
     if causal:
         qrow = qi * block_q + lax.broadcasted_iota(jnp.int32,
                                                    (block_k, block_q), 1)
         mask = mask & (qrow >= kcol)
+    if qseg_row is not None:
+        mask = mask & (kseg_col == qseg_row)    # (bk,1)==(1,bq)
     sT = jnp.where(mask, sT, _NEG_INF)
     return jnp.exp(sT - lse_row)           # lse_row: (1, block_q)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
-               acc_ref, *, scale, causal, block_q, block_k, seq_k, n_k):
+def _bwd_unpack(rest, has_lens, has_seg):
+    rest = list(rest)
+    lens_ref = rest.pop(0) if has_lens else None
+    qseg_ref = rest.pop(0) if has_seg else None
+    kseg_ref = rest.pop(0) if has_seg else None
+    return lens_ref, qseg_ref, kseg_ref, rest
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
+               scale, causal, block_q, block_k, seq_k, n_k,
+               has_lens, has_seg):
     import jax.experimental.pallas as pl
+
+    lens_ref, qseg_ref, kseg_ref, rest = _bwd_unpack(rest, has_lens, has_seg)
+    dq_ref, acc_ref = rest
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    kvlen = lens_ref[pl.program_id(0), 0] if has_lens else None
 
     @pl.when(ki == 0)
     def _init():
@@ -211,7 +300,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
         lse_row = lse_ref[0]                    # (1, block_q)
         dlt_row = dlt_ref[0]
         pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
-                       seq_k, causal)
+                       seq_k, causal, kvlen=kvlen,
+                       qseg_row=qseg_ref[0] if has_seg else None,
+                       kseg_col=kseg_ref[0] if has_seg else None)
         dpT = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
         dsT = pT * (dpT - dlt_row) * scale      # (block_k, block_q)
@@ -219,24 +310,32 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
             dsT.astype(q.dtype), k, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    run = True
     if causal:
         run = (qi * block_q + block_q - 1) >= (ki * block_k)
-        pl.when(run)(_compute)
-    else:
+    if has_lens:
+        run = run & (ki * block_k < kvlen)
+    if run is True:
         _compute()
+    else:
+        pl.when(run)(_compute)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
         dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *,
-                scale, causal, block_q, block_k, seq_k, n_q):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
+                scale, causal, block_q, block_k, seq_k, n_q,
+                has_lens, has_seg):
     import jax.experimental.pallas as pl
+
+    lens_ref, qseg_ref, kseg_ref, rest = _bwd_unpack(rest, has_lens, has_seg)
+    dk_ref, dv_ref, dk_acc, dv_acc = rest
 
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    kvlen = lens_ref[pl.program_id(0), 0] if has_lens else None
 
     @pl.when(qi == 0)
     def _init():
@@ -251,7 +350,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
         lse_row = lse_ref[0]
         dlt_row = dlt_ref[0]
         pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
-                       seq_k, causal)
+                       seq_k, causal, kvlen=kvlen,
+                       qseg_row=qseg_ref[0] if has_seg else None,
+                       kseg_col=kseg_ref[0] if has_seg else None)
         dv_acc[...] += lax.dot_general(
             pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -262,11 +363,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
             dsT.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    run = True
     if causal:
         run = (qi * block_q + block_q - 1) >= (ki * block_k)
-        pl.when(run)(_compute)
-    else:
+    if has_lens:
+        # dk/dv of keys past the valid length are zero — skip the block
+        run = run & (ki * block_k < kvlen)
+    if run is True:
         _compute()
+    else:
+        pl.when(run)(_compute)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -276,7 +382,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
 
 def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
                                scale=None, block_q: int = 1024,
-                               block_k: int = 2048, interpret: bool = False):
+                               block_k: int = 2048, interpret: bool = False,
+                               kv_lens=None, q_segments=None,
+                               kv_segments=None):
     """Flash backward: (dq, dk, dv) without materialising (Tq, Tk)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -304,8 +412,34 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
     n_q = Tqp // block_q
     n_k = Tkp // block_k
 
+    # mask operands, bwd orientation: q segments as lane rows, kv segments
+    # as sublane columns (scores are transposed in the backward kernels)
+    lens, qs_row, ks_col = _expand_mask_operands(
+        kv_lens, q_segments, kv_segments, B, H, Tqp, Tkp, transposed=True)
+
     common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, seq_k=Tk)
+                  block_k=block_k, seq_k=Tk, has_lens=lens is not None,
+                  has_seg=qs_row is not None)
+
+    def extra_for(kv_idx, q_idx):
+        # kv_idx/q_idx map grid coords -> (k-block index, q-block index)
+        ops, specs = [], []
+        if lens is not None:
+            ops.append(lens)
+            specs.append(pl.BlockSpec(
+                lens.shape, lambda b, i, j: (0, 0),
+                memory_space=pltpu.SMEM))
+        if qs_row is not None:
+            ops += [qs_row, ks_col]
+            specs += [
+                pl.BlockSpec((1, 1, block_q),
+                             lambda b, i, j: (b, 0, q_idx(i, j))),
+                pl.BlockSpec((1, block_k, 1),
+                             lambda b, i, j: (b, kv_idx(i, j), 0)),
+            ]
+        return ops, specs
+
+    dq_extra, dq_especs = extra_for(lambda i, j: j, lambda i, j: i)
     qkv_specs = [
         pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
         pl.BlockSpec((1, block_k, Dp), lambda b, qi, ki: (b, ki, 0)),
@@ -313,7 +447,7 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
         pl.BlockSpec((1, block_q, Dp), lambda b, qi, ki: (b, qi, 0)),
         pl.BlockSpec((1, 1, block_q), lambda b, qi, ki: (b, 0, qi)),
         pl.BlockSpec((1, 1, block_q), lambda b, qi, ki: (b, 0, qi)),
-    ]
+    ] + dq_especs
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, n_k=n_k, **common),
         grid=(B * H, n_q, n_k),
@@ -325,8 +459,9 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dltp)
+    )(qp, kp, vp, dop, lsep, dltp, *dq_extra)
 
+    kv_extra, kv_especs = extra_for(lambda i, j: i, lambda i, j: j)
     kv_specs = [
         pl.BlockSpec((1, block_q, Dp), lambda b, ki, qi: (b, qi, 0)),
         pl.BlockSpec((1, block_k, Dp), lambda b, ki, qi: (b, ki, 0)),
@@ -334,7 +469,7 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
         pl.BlockSpec((1, block_q, Dp), lambda b, ki, qi: (b, qi, 0)),
         pl.BlockSpec((1, 1, block_q), lambda b, ki, qi: (b, 0, qi)),
         pl.BlockSpec((1, 1, block_q), lambda b, ki, qi: (b, 0, qi)),
-    ]
+    ] + kv_especs
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, n_q=n_q, **common),
         grid=(B * H, n_k, n_q),
@@ -352,7 +487,7 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dltp)
+    )(qp, kp, vp, dop, lsep, dltp, *kv_extra)
 
     dq = dq.reshape(B, H, Tqp, Dp)[:, :, :Tq, :D]
     dk = dk.reshape(B, H, Tkp, Dp)[:, :, :Tk, :D]
@@ -372,38 +507,83 @@ def _use_pallas(*arrays):
     return platform == "tpu"
 
 
+def _int_zero_cotangent(x):
+    """Cotangent for integer-valued primals (mask operands): float0 zeros,
+    or None when the primal was absent."""
+    if x is None:
+        return None
+    import numpy as onp
+    return onp.zeros(x.shape, jax.dtypes.float0)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, scale=None):
+def flash_attention(q, k, v, causal=False, scale=None, kv_lens=None,
+                    q_segments=None, kv_segments=None):
     """Fused attention: Pallas kernels on TPU, jnp blockwise elsewhere.
 
-    softmax(q·kᵀ·scale [+ causal mask])·v over (B, H, T, D) inputs."""
-    return _flash_fwd(q, k, v, causal, scale)[0]
+    softmax(q·kᵀ·scale [+ masks])·v over (B, H, T, D) inputs.  Masking:
+    ``causal`` (static), ``kv_lens`` (B,) per-row valid key length
+    (padding mask — blocks past the length are skipped, not just masked),
+    and ``q_segments``/``kv_segments`` (B, T) packed-sequence ids.
+    Rows with no visible key return 0."""
+    return _flash_fwd(q, k, v, causal, scale, kv_lens, q_segments,
+                      kv_segments)[0]
 
 
-def _reference_attention(q, k, v, causal, scale):
-    from ..parallel.ring_attention import blockwise_attention
-    return blockwise_attention(q, k, v, causal=causal, scale=scale)
+def _reference_attention(q, k, v, causal, scale, kv_lens=None,
+                         q_segments=None, kv_segments=None):
+    if kv_lens is None and q_segments is None:
+        from ..parallel.ring_attention import blockwise_attention
+        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+    # masked dense oracle (test/CPU path): additive -inf mask, fp32 softmax
+    D = q.shape[-1]
+    Tq, Tk = q.shape[2], k.shape[2]
+    sc = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc
+    mask = jnp.ones((q.shape[0], 1, Tq, Tk), bool)
+    if kv_lens is not None:
+        mask = mask & (jnp.arange(Tk)[None, None, None, :]
+                       < kv_lens[:, None, None, None])
+    if q_segments is not None:
+        mask = mask & (q_segments[:, None, :, None]
+                       == kv_segments[:, None, None, :])
+    if causal:
+        mask = mask & (jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :])
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows: uniform softmax garbage -> force exact zeros,
+    # matching the kernel's l==0 convention
+    any_visible = jnp.any(mask, axis=-1, keepdims=True)
+    p = jnp.where(any_visible, p, 0.0).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _flash_fwd(q, k, v, causal, scale, kv_lens, q_segments, kv_segments):
     if _use_pallas(q, k, v):
-        out, lse = pallas_flash_attention(q, k, v, causal=causal,
-                                          scale=scale, return_lse=True)
-        return out, (q, k, v, out, lse)
-    out = _reference_attention(q, k, v, causal, scale)
-    return out, (q, k, v, None, None)
+        out, lse = pallas_flash_attention(
+            q, k, v, causal=causal, scale=scale, return_lse=True,
+            kv_lens=kv_lens, q_segments=q_segments, kv_segments=kv_segments)
+        return out, (q, k, v, out, lse, kv_lens, q_segments, kv_segments)
+    out = _reference_attention(q, k, v, causal, scale, kv_lens, q_segments,
+                               kv_segments)
+    return out, (q, k, v, None, None, kv_lens, q_segments, kv_segments)
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, kv_lens, q_segments, kv_segments = res
     if lse is not None:
-        return pallas_flash_attention_bwd(q, k, v, out, lse, g,
-                                          causal=causal, scale=scale)
-    # recompute-based VJP through the memory-linear jnp path
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, scale),
-        q, k, v)
-    return vjp(g)
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, out, lse, g, causal=causal, scale=scale,
+            kv_lens=kv_lens, q_segments=q_segments, kv_segments=kv_segments)
+    else:
+        # recompute-based VJP through the memory-linear jnp path
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(
+                q_, k_, v_, causal, scale, kv_lens, q_segments, kv_segments),
+            q, k, v)
+        dq, dk, dv = vjp(g)
+    return (dq, dk, dv, _int_zero_cotangent(kv_lens),
+            _int_zero_cotangent(q_segments), _int_zero_cotangent(kv_segments))
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -411,8 +591,11 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 @register("_contrib_flash_attention", aliases=("flash_attention",))
 def _flash_attention_op(queries, keys, values, causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None, kv_lens=None,
+                        q_segments=None, kv_segments=None):
     """Fused multi-head attention op (TPU-native counterpart of the
     reference's ``_contrib_interleaved_matmul_selfatt_*`` pipeline,
-    src/operator/contrib/transformer.cc)."""
-    return flash_attention(queries, keys, values, causal, scale)
+    src/operator/contrib/transformer.cc).  The mask operands follow
+    causal/scale so pre-mask positional callers keep working."""
+    return flash_attention(queries, keys, values, causal, scale, kv_lens,
+                           q_segments, kv_segments)

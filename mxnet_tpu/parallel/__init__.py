@@ -37,7 +37,8 @@ from .collectives import (  # noqa: F401
 from .data_parallel import DataParallelStep  # noqa: F401
 from .ring_attention import (  # noqa: F401
     blockwise_attention, ring_attention, ring_attention_sharded)
-from .pipeline import pipeline_apply  # noqa: F401
+from .pipeline import (pipeline_apply, pipeline_train_step,  # noqa: F401
+                       PipelineTrainer)
 
 __all__ = [
     "Mesh", "NamedSharding", "P",
@@ -46,6 +47,8 @@ __all__ = [
     "DataParallelStep", "ring_attention", "ring_attention_sharded",
     "blockwise_attention", "shard_batch", "replicate", "initialize",
     "pipeline_apply",
+    "pipeline_train_step",
+    "PipelineTrainer",
 ]
 
 

@@ -34,6 +34,46 @@ from .mesh import get_mesh
 __all__ = ["DataParallelStep"]
 
 
+def _resolve_mirror(mirror):
+    """Normalise the backward-mirror knob.
+
+    TPU-native equivalent of the reference's gradient-mirroring pass
+    (``MXNET_BACKWARD_DO_MIRROR``, graph_executor.cc:351-374 /
+    docs/faq/env_var.md:181-186): instead of marking node outputs for
+    recompute in a graph pass, the whole forward is wrapped in
+    ``jax.checkpoint`` with a save-policy.  ``"mirror"`` (env value 1)
+    keeps MXU outputs (conv results, matmul dots, BN stats — tagged via
+    ``checkpoint_name``) and recomputes the cheap elementwise chain
+    (BN apply / ReLU / residual adds) in the backward, trading idle MXU
+    FLOPs for HBM activation traffic.  ``"full"`` (env value 2) saves
+    nothing but the step inputs — maximum memory saving.
+    """
+    if mirror is None:
+        import os
+        mirror = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "")
+    if mirror in (False, None, "", "0", 0):
+        return None
+    if mirror in (True, 1, "1", "mirror"):
+        return "mirror"
+    if mirror in (2, "2", "full"):
+        return "full"
+    raise ValueError("mirror must be one of None/'mirror'/'full', got %r"
+                     % (mirror,))
+
+
+def _mirror_wrap(fn, mode):
+    """Wrap ``fn`` in jax.checkpoint per the mirror mode (None = no-op)."""
+    if not mode:
+        return fn
+    if mode == "full":
+        return jax.checkpoint(fn)
+    from jax import checkpoint_policies as _cp
+    policy = _cp.save_from_both_policies(
+        _cp.save_only_these_names("conv_out", "bn_stats"),
+        _cp.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(fn, policy=policy)
+
+
 class DataParallelStep:
     """Compile a Gluon block + loss + optimizer into one jitted train step.
 
@@ -47,12 +87,14 @@ class DataParallelStep:
     deferred shapes).
     """
 
-    def __init__(self, net, loss_fn, optimizer, mesh=None, donate=True):
+    def __init__(self, net, loss_fn, optimizer, mesh=None, donate=True,
+                 mirror=None):
         self._net = net
         self._loss = loss_fn
         self._opt = optimizer
         self._mesh = mesh if mesh is not None else get_mesh()
         self._donate = donate
+        self._mirror = _resolve_mirror(mirror)
         params = [p for _, p in sorted(net.collect_params().items())
                   if p._data is not None]
         self._params = params
@@ -82,13 +124,23 @@ class DataParallelStep:
     # ------------------------------------------------------------------
     def __call__(self, data, label):
         from . import shard_batch
-        if self._mesh is not None:
-            data = shard_batch(data, self._mesh)
-            label = shard_batch(label, self._mesh)
-        dval = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        lval = label._data if isinstance(label, NDArray) else jnp.asarray(label)
-        key = (tuple(dval.shape), str(dval.dtype),
-               tuple(lval.shape), str(lval.dtype))
+
+        def prep(x):
+            if x is None:
+                return None
+            if self._mesh is not None:
+                x = shard_batch(x, self._mesh)
+            return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+        # data may be a tuple of forward inputs (None entries allowed),
+        # e.g. (tokens, token_types, mask, valid_length) for BERT
+        dval = (tuple(prep(d) for d in data) if isinstance(data, (tuple, list))
+                else prep(data))
+        lval = prep(label)
+        sig = lambda v: (None if v is None
+                         else (tuple(v.shape), str(v.dtype)))
+        key = (tuple(sig(d) for d in dval) if isinstance(dval, tuple)
+               else sig(dval), sig(lval))
         jfn = self._cache.get(key)
         if jfn is None:
             jfn = self._build()
@@ -134,7 +186,12 @@ class DataParallelStep:
                 prev_train = autograd.set_training(True)
                 try:
                     with _random.key_supply(rng):
-                        out = net.forward(_wrap(dval))
+                        if isinstance(dval, tuple):
+                            args = [None if d is None else _wrap(d)
+                                    for d in dval]
+                            out = net.forward(*args)
+                        else:
+                            out = net.forward(_wrap(dval))
                         loss = loss_fn(out, _wrap(lval))
                 finally:
                     autograd.set_recording(prev_rec)
@@ -151,6 +208,8 @@ class DataParallelStep:
                     p._data._data = old
                     p._data._ag = ag
 
+        fwd = _mirror_wrap(run_forward, self._mirror)
+
         def step_fn(pvals, opt_states, t, lrs, rng, dval, lval):
             train_vals = [pvals[i] for i in trainable]
 
@@ -158,7 +217,7 @@ class DataParallelStep:
                 full = list(pvals)
                 for i, v in zip(trainable, tvals):
                     full[i] = v
-                return run_forward(full, rng, dval, lval)
+                return fwd(full, rng, dval, lval)
 
             (loss_val, mutated), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_vals)

@@ -27,7 +27,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_train_step", "PipelineTrainer"]
+
+
+def _shard_map(fn, **kwargs):
+    try:
+        from jax import shard_map  # jax >= 0.8: top-level function
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:  # older jax spelling
+        return shard_map(fn, check_rep=False, **kwargs)
 
 
 def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh,
@@ -84,9 +95,161 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh,
 
     in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
                 P())
-    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=P())
-    try:
-        fn = shard_map(per_shard, check_vma=False, **kwargs)
-    except TypeError:  # older jax spelling
-        fn = shard_map(per_shard, check_rep=False, **kwargs)
+    fn = _shard_map(per_shard, mesh=mesh, in_specs=in_specs, out_specs=P())
     return fn(stacked_params, microbatches)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous stages: a model (embedding / blocks / head) trains pipelined
+# ---------------------------------------------------------------------------
+
+def pipeline_train_step(stage_fns, params, inputs, labels, mesh: Mesh,
+                        axis: str = "pp"):
+    """Mean loss of a heterogeneous GPipe pipeline — differentiable.
+
+    Unlike :func:`pipeline_apply` (one shared ``stage_fn`` over stacked
+    params), stages here are arbitrary per-stage functions with their own
+    parameter pytrees, so an embedding→blocks→head model runs end-to-end:
+
+    * ``stage_fns[0](params[0], x_mb) -> act`` — ingests a microbatch of
+      raw inputs (e.g. token ids), emits the wire activation;
+    * ``stage_fns[i](params[i], act) -> act`` — middle stages; every
+      stage's output must share ONE wire shape (the ppermute payload);
+    * ``stage_fns[-1](params[-1], act, y_mb) -> scalar`` — the head:
+      per-microbatch mean loss.
+
+    Each device runs only its own stage (``lax.switch`` on the stage
+    index); microbatches stream through the ``ppermute`` ring with the
+    classic fill+drain schedule, losses leave through a ``psum``.  The
+    returned scalar is the mean loss over all ``n_micro`` microbatches,
+    replicated — so ``jax.grad`` through this function yields, via
+    shard_map's replicated-input transpose, full parameter gradients
+    (each device contributes exactly its stage's terms).
+
+    ``params`` is a tuple of per-stage pytrees, replicated over the mesh
+    (the memory-scaled layout for *homogeneous* stacks remains
+    ``pipeline_apply``, whose stacked params live one-stage-per-device).
+    ``inputs``/``labels`` are ``(n_micro, mb, ...)`` streams.
+    """
+    nstage = mesh.shape[axis]
+    if len(stage_fns) != nstage:
+        raise ValueError("need exactly %d stage fns (one per %r slice), "
+                         "got %d" % (nstage, axis, len(stage_fns)))
+    if len(params) != nstage:
+        raise ValueError("need %d per-stage param trees, got %d"
+                         % (nstage, len(params)))
+    n_micro = inputs.shape[0]
+    ticks = n_micro + nstage - 1
+    fwd_perm = [(i, (i + 1) % nstage) for i in range(nstage)]
+    act_shape = jax.eval_shape(stage_fns[0], params[0], inputs[0])
+
+    def per_shard(params, xs, ys):
+        stage = lax.axis_index(axis)
+        is_last = stage == nstage - 1
+
+        def mk_branch(i):
+            if i == 0:
+                return lambda op: (stage_fns[0](params[0], op[1]),
+                                   jnp.float32(0.0))
+            if i == nstage - 1:
+                return lambda op: (
+                    jnp.zeros(act_shape.shape, act_shape.dtype),
+                    stage_fns[-1](params[-1], op[0],
+                                  op[2]).astype(jnp.float32))
+            return lambda op: (stage_fns[i](params[i], op[0]),
+                               jnp.float32(0.0))
+
+        branches = [mk_branch(i) for i in range(nstage)]
+        act0 = jnp.zeros(act_shape.shape, act_shape.dtype)
+
+        def tick(act, t):
+            feed = jnp.minimum(t, n_micro - 1)
+            lab = jnp.clip(t - (nstage - 1), 0, n_micro - 1)
+            out, loss = lax.switch(stage, branches,
+                                   (act, xs[feed], ys[lab]))
+            emit = ((t >= nstage - 1) & is_last).astype(jnp.float32)
+            loss_t = lax.psum(loss * emit, axis)
+            return lax.ppermute(out, axis, fwd_perm), loss_t
+
+        _, losses = lax.scan(tick, act0, jnp.arange(ticks))
+        return jnp.sum(losses) / n_micro
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(), params), P(), P())
+    fn = _shard_map(per_shard, mesh=mesh, in_specs=in_specs, out_specs=P())
+    return fn(params, inputs, labels)
+
+
+class PipelineTrainer:
+    """Train a heterogeneous-stage model pipelined over a ``pp`` mesh axis.
+
+    The Trainer-shaped consumer of :func:`pipeline_train_step`: holds the
+    per-stage params, compiles ONE jitted program per input signature
+    (value_and_grad through the pipeline + an mxnet-style optimizer
+    update on every leaf, buffers donated), and steps in place::
+
+        trainer = PipelineTrainer(stage_fns, params,
+                                  mx.optimizer.SGD(learning_rate=0.1), mesh)
+        loss = trainer.step(micro_inputs, micro_labels)   # params updated
+    """
+
+    def __init__(self, stage_fns, params, optimizer, mesh: Mesh,
+                 axis: str = "pp"):
+        self._fns = list(stage_fns)
+        self._mesh = mesh
+        self._axis = axis
+        self._opt = optimizer
+        from ..ndarray import NDArray
+        from ..ndarray.ndarray import _wrap
+        leaves, self._treedef = jax.tree_util.tree_flatten(tuple(params))
+        # own copies: step() donates its param buffers, which must never
+        # invalidate the caller's arrays
+        self.params = [jnp.array(l, copy=True) for l in leaves]
+        leaves = self.params
+        self._states = []
+        for i, leaf in enumerate(leaves):
+            st = optimizer.create_state(i, _wrap(jnp.asarray(leaf)))
+            st_leaves, _ = jax.tree_util.tree_flatten(
+                st, is_leaf=lambda x: isinstance(x, NDArray))
+            self._states.append([s._data if isinstance(s, NDArray) else s
+                                 for s in st_leaves])
+        self._t = 0
+        self._jitted = {}
+
+    def _build(self):
+        fns, treedef, axis, mesh = (self._fns, self._treedef, self._axis,
+                                    self._mesh)
+        opt = self._opt
+        steps = [opt.make_step(i) for i in range(len(self.params))]
+
+        def step_fn(leaves, states, t, lr, xs, ys):
+            def loss_of(leaves):
+                params = jax.tree_util.tree_unflatten(treedef, leaves)
+                return pipeline_train_step(fns, params, xs, ys, mesh, axis)
+
+            loss, grads = jax.value_and_grad(loss_of)(leaves)
+            new_leaves, new_states = [], []
+            for i, (w, g) in enumerate(zip(leaves, grads)):
+                res = steps[i](w, g, t, lr.astype(w.dtype), *states[i])
+                new_leaves.append(res[0])
+                new_states.append(list(res[1:]))
+            return new_leaves, new_states, loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def step(self, inputs, labels):
+        key = (tuple(inputs.shape), str(inputs.dtype),
+               tuple(labels.shape), str(labels.dtype))
+        jfn = self._jitted.get(key)
+        if jfn is None:
+            jfn = self._jitted[key] = self._build()
+        self._t += 1
+        self._opt.num_update = max(self._opt.num_update, self._t)
+        lr = jnp.asarray(self._opt._get_lrs([0])[0], jnp.float32)
+        self.params, self._states, loss = jfn(
+            self.params, self._states, jnp.asarray(self._t, jnp.int32), lr,
+            inputs, labels)
+        return loss
+
+    def stage_params(self):
+        """The current params as the per-stage tuple-of-pytrees."""
+        return jax.tree_util.tree_unflatten(self._treedef, self.params)

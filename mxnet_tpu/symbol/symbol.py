@@ -382,6 +382,21 @@ class Symbol:
             args_grad = None
         return Executor(self, ctx, args, args_grad, grad_req, aux)
 
+    # -- subgraph backends ---------------------------------------------
+    def get_backend_symbol(self, backend):
+        """Rewrite through a registered subgraph property (reference
+        symbol.py get_backend_symbol / MXBuildSubgraphByBackend); see
+        ``mxnet_tpu.subgraph``.  Structure only — use
+        ``subgraph.optimize_for`` to also fold parameter values."""
+        from ..subgraph import optimize_for
+        return optimize_for(self, backend)
+
+    def optimize_for(self, backend, args=None, aux=None):
+        """get_backend_symbol + parameter folding in one call (the later
+        reference spelling, python/mxnet/symbol/symbol.py optimize_for)."""
+        from ..subgraph import optimize_for
+        return optimize_for(self, backend, args, aux)
+
     # gluon interop
     def var_names(self):
         return self.list_inputs()

@@ -65,6 +65,181 @@ def test_pallas_bwd_kernels_match_dense_vjp(causal):
         assert float(jnp.max(jnp.abs(got - want))) < 5e-5
 
 
+def _dense_masked(q, k, v, kv_lens=None, q_seg=None, kv_seg=None,
+                  causal=False):
+    d = q.shape[-1]
+    tq, tk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * d ** -0.5
+    mask = jnp.ones((q.shape[0], 1, tq, tk), bool)
+    if kv_lens is not None:
+        mask = mask & (jnp.arange(tk)[None, None, None, :]
+                       < kv_lens[:, None, None, None])
+    if q_seg is not None:
+        mask = mask & (q_seg[:, None, :, None] == kv_seg[:, None, None, :])
+    if causal:
+        mask = mask & (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.any(mask, axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+@pytest.mark.parametrize("lens", [[256, 131], [1, 256]])
+def test_pallas_kv_lens_matches_dense(lens):
+    shape = (2, 2, 256, 64)
+    q, k, v = (_rand(shape, 40 + i) for i in range(3))
+    kv_lens = jnp.asarray(lens, jnp.int32)
+    out = P.pallas_flash_attention(q, k, v, interpret=True, block_q=128,
+                                   block_k=128, kv_lens=kv_lens)
+    ref = _dense_masked(q, k, v, kv_lens=kv_lens)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_pallas_kv_lens_bwd_matches_dense_vjp():
+    shape = (2, 2, 256, 64)
+    q, k, v = (_rand(shape, 50 + i) for i in range(3))
+    g = _rand(shape, 53)
+    kv_lens = jnp.asarray([200, 77], jnp.int32)
+    out, lse = P.pallas_flash_attention(
+        q, k, v, interpret=True, return_lse=True, block_q=128, block_k=128,
+        kv_lens=kv_lens)
+    dq, dk, dv = P.pallas_flash_attention_bwd(
+        q, k, v, out, lse, g, interpret=True, block_q=128, block_k=128,
+        kv_lens=kv_lens)
+    _, vjp = jax.vjp(lambda a, b, c: _dense_masked(a, b, c, kv_lens),
+                     q, k, v)
+    rq, rk, rv = vjp(g)
+    for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+        assert float(jnp.max(jnp.abs(got - want))) < 5e-5
+    # masked-out keys get exactly zero dk/dv (their blocks are skipped)
+    assert float(jnp.max(jnp.abs(dk[1, :, 77:]))) == 0.0
+    assert float(jnp.max(jnp.abs(dv[1, :, 77:]))) == 0.0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_segment_ids_match_dense(causal):
+    shape = (2, 2, 256, 32)
+    q, k, v = (_rand(shape, 60 + i) for i in range(3))
+    # packed sequences: two segments per row, split at different points
+    seg = onp.zeros((2, 256), onp.int32)
+    seg[0, 100:] = 1
+    seg[1, 180:] = 1
+    seg = jnp.asarray(seg)
+    out = P.pallas_flash_attention(
+        q, k, v, causal=causal, interpret=True, block_q=128, block_k=128,
+        q_segments=seg, kv_segments=seg)
+    ref = _dense_masked(q, k, v, q_seg=seg, kv_seg=seg, causal=causal)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_pallas_segment_ids_bwd_matches_dense_vjp():
+    shape = (1, 2, 256, 32)
+    q, k, v = (_rand(shape, 70 + i) for i in range(3))
+    g = _rand(shape, 73)
+    seg = jnp.asarray(onp.repeat([[0, 1]], 128, axis=1).reshape(1, 256))
+    out, lse = P.pallas_flash_attention(
+        q, k, v, interpret=True, return_lse=True, block_q=128, block_k=128,
+        q_segments=seg, kv_segments=seg)
+    dq, dk, dv = P.pallas_flash_attention_bwd(
+        q, k, v, out, lse, g, interpret=True, block_q=128, block_k=128,
+        q_segments=seg, kv_segments=seg)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _dense_masked(a, b, c, q_seg=seg, kv_seg=seg),
+        q, k, v)
+    rq, rk, rv = vjp(g)
+    for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+        assert float(jnp.max(jnp.abs(got - want))) < 5e-5
+
+
+def test_fully_masked_rows_emit_zero_and_zero_grads():
+    """A q row whose segment matches no key must return exactly 0 with
+    zero dq, and contribute nothing to dk/dv (regression: the online
+    softmax saw exp(s - m_new) == 1 when the whole row was -inf)."""
+    shape = (1, 2, 128, 32)
+    q, k, v = (_rand(shape, 90 + i) for i in range(3))
+    g = _rand(shape, 93)
+    q_seg = jnp.asarray(onp.where(onp.arange(128) < 64, 0, 7)[None, :])
+    kv_seg = jnp.zeros((1, 128), jnp.int32)       # id 7 matches nothing
+    out, lse = P.pallas_flash_attention(
+        q, k, v, interpret=True, return_lse=True, block_q=64, block_k=64,
+        q_segments=q_seg, kv_segments=kv_seg)
+    assert float(jnp.max(jnp.abs(out[:, :, 64:]))) == 0.0
+    assert float(jnp.max(jnp.abs(lse[:, :, 64:]))) == 0.0
+    dq, dk, dv = P.pallas_flash_attention_bwd(
+        q, k, v, out, lse, g, interpret=True, block_q=64, block_k=64,
+        q_segments=q_seg, kv_segments=kv_seg)
+    assert float(jnp.max(jnp.abs(dq[:, :, 64:]))) == 0.0
+    _, vjp = jax.vjp(
+        lambda a, b, c: _dense_masked(a, b, c, q_seg=q_seg, kv_seg=kv_seg),
+        q, k, v)
+    rq, rk, rv = vjp(g)
+    for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+        assert float(jnp.max(jnp.abs(got - want))) < 5e-5
+
+
+def test_mha_mask_plus_valid_length_combines():
+    """Dense path: an explicit additive mask AND valid_length together —
+    padded keys must still be excluded."""
+    from mxnet_tpu.gluon.contrib.nn import MultiHeadAttention
+    mx.random.seed(0)
+    attn = MultiHeadAttention(units=32, num_heads=2)
+    attn.initialize()
+    x = mx.nd.array(onp.random.RandomState(7).uniform(
+        -1, 1, (2, 48, 32)).astype("float32"))
+    attn(x)
+    zero_mask = mx.nd.zeros((2, 1, 1, 48))
+    vl = mx.nd.array(onp.array([48, 20]), dtype="int32")
+    got = attn(x, zero_mask, vl).asnumpy()
+    # reference: additive mask that encodes the same padding
+    add = onp.zeros((2, 1, 1, 48), "float32")
+    add[1, :, :, 20:] = -1e30
+    want = attn(x, mx.nd.array(add)).asnumpy()
+    assert onp.abs(got[0] - want[0]).max() < 2e-5
+    assert onp.abs(got[1, :20] - want[1, :20]).max() < 2e-5
+
+
+def test_flash_attention_custom_vjp_masked_fallback():
+    """The public custom-vjp op with kv_lens via the CPU fallback path."""
+    shape = (2, 2, 128, 32)
+    q, k, v = (_rand(shape, 80 + i) for i in range(3))
+    kv_lens = jnp.asarray([128, 57], jnp.int32)
+
+    def loss(q, k, v):
+        return jnp.sum(P.flash_attention(q, k, v, False, None,
+                                         kv_lens) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense_masked(q, k, v, kv_lens=kv_lens) ** 2)
+
+    assert float(jnp.abs(loss(q, k, v) - dense_loss(q, k, v))) < 1e-3
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for g1, g2 in zip(got, want):
+        assert float(jnp.max(jnp.abs(g1 - g2))) < 5e-5
+
+
+def test_transformer_valid_length_routes_flash():
+    """MultiHeadAttention(valid_length=...) == explicit additive mask."""
+    from mxnet_tpu.gluon.contrib.nn import MultiHeadAttention
+    mx.random.seed(0)
+    attn = MultiHeadAttention(units=64, num_heads=4)
+    attn.initialize()
+    x = mx.nd.array(onp.random.RandomState(5).uniform(
+        -1, 1, (2, 96, 64)).astype("float32"))
+    attn(x)  # materialize
+    vl = mx.nd.array(onp.array([96, 40]), dtype="int32")
+    out_flash = attn(x, None, vl)
+    # dense path: additive -inf on padded keys
+    add = onp.zeros((2, 1, 1, 96), "float32")
+    add[1, :, :, 40:] = -1e30
+    out_dense = attn(x, mx.nd.array(add))
+    got = out_flash.asnumpy()
+    want = out_dense.asnumpy()
+    # padded q rows differ (garbage either way); compare valid rows
+    assert onp.abs(got[0] - want[0]).max() < 2e-5
+    assert onp.abs(got[1, :40] - want[1, :40]).max() < 2e-5
+
+
 def test_flash_attention_op_and_grad_fallback():
     """The registered op (jnp fallback off-TPU) forward + custom-vjp grad."""
     shape = (1, 2, 128, 32)

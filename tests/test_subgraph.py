@@ -1,0 +1,104 @@
+"""Subgraph property framework: registry + the conv+BN inference fold
+(reference ``src/operator/subgraph/subgraph_property.h`` and the mkldnn
+conv+BN fusion it hosts)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph
+from mxnet_tpu import symbol as sym
+
+
+def _net():
+    d = sym.var("data")
+    x = sym.Convolution(data=d, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                        no_bias=True, name="conv1")
+    x = sym.BatchNorm(data=x, fix_gamma=False, name="bn1")
+    x = sym.Activation(data=x, act_type="relu", name="relu1")
+    x = sym.Convolution(data=x, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                        no_bias=False, name="conv2")
+    x = sym.BatchNorm(data=x, fix_gamma=True, name="bn2")
+    x = sym.Pooling(data=x, global_pool=True, pool_type="avg", name="pool")
+    x = sym.FullyConnected(data=x, num_hidden=3, name="fc")
+    return x
+
+
+def _random_params(net, data_shape):
+    rs = onp.random.RandomState(0)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=data_shape)
+    args, aux = {}, {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        args[name] = mx.nd.array(rs.uniform(-0.5, 0.5, shp)
+                                 .astype("float32"))
+    for name, shp in zip(net.list_auxiliary_states(), aux_shapes):
+        if name.endswith("moving_var"):
+            aux[name] = mx.nd.array(rs.uniform(0.5, 2.0, shp)
+                                    .astype("float32"))
+        else:
+            aux[name] = mx.nd.array(rs.uniform(-0.5, 0.5, shp)
+                                    .astype("float32"))
+    return args, aux
+
+
+def _run(net, args, aux, data):
+    ex = net.bind(ctx=mx.cpu(),
+                  args={**args, "data": data},
+                  args_grad=None, grad_req="null",
+                  aux_states=aux)
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def test_conv_bn_fold_matches_and_removes_bn():
+    net = _net()
+    data = mx.nd.array(onp.random.RandomState(1)
+                       .uniform(-1, 1, (2, 3, 16, 16)).astype("float32"))
+    args, aux = _random_params(net, (2, 3, 16, 16))
+    want = _run(net, args, aux, data)
+
+    fused, fargs, faux = net.optimize_for("CONV_BN_FOLD", args, aux)
+    # all BatchNorm nodes folded away, their params gone
+    assert "BatchNorm" not in fused.tojson()
+    assert not faux
+    assert "conv1_folded_weight" in fargs and "conv2_folded_bias" in fargs
+    assert len(fargs) < len(args)
+    got = _run(fused, fargs, faux, data)
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_conv_bn_fold_op_count_reduced():
+    net = _net()
+    fused = net.get_backend_symbol("CONV_BN_FOLD")
+    import json
+    n_before = len([n for n in json.loads(net.tojson())["nodes"]
+                    if n["op"] != "null"])
+    n_after = len([n for n in json.loads(fused.tojson())["nodes"]
+                   if n["op"] != "null"])
+    assert n_after == n_before - 2        # two BN nodes gone
+
+
+def test_shared_conv_output_not_folded():
+    """A conv consumed by BN *and* another op must not be folded (the
+    second consumer needs the un-normalized activation)."""
+    d = sym.var("data")
+    c = sym.Convolution(data=d, num_filter=4, kernel=(1, 1), no_bias=True,
+                        name="conv")
+    b = sym.BatchNorm(data=c, name="bn")
+    out = b + c                            # second consumer of conv
+    fused = out.get_backend_symbol("CONV_BN_FOLD")
+    assert "BatchNorm" in fused.tojson()   # left untouched
+
+
+def test_registry_api():
+    assert "CONV_BN_FOLD" in subgraph.list_subgraph_properties()
+    with pytest.raises(mx.MXNetError):
+        subgraph.get_subgraph_property("NOPE")
+
+    @subgraph.register_subgraph_property("TEST_IDENTITY")
+    class Ident(subgraph.SubgraphProperty):
+        def apply(self, s):
+            return s
+
+    net = _net()
+    assert net.get_backend_symbol("test_identity") is not None

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Cost-analysis probe: XLA bytes/flops of the ResNet-50 train step with
+and without backward-mirror remat (scratch tool for the roofline note)."""
+import json
+import sys
+
+sys.path.insert(0, ".")
+import bench
+
+
+def analyze(bs, dtype, mode):
+    import jax
+    import mxnet_tpu as mx
+    step, data, label = bench._build_train_step("resnet50_v1", bs, dtype,
+                                                mirror=mode)
+    # reach the inner jitted fn the way __call__ does, then lower it
+    import jax.numpy as jnp
+    from mxnet_tpu import random as _random
+    dval, lval = data._data, label._data
+    jfn = step._build()          # the jax.jit-wrapped step
+    lrs = jnp.zeros((len(step._trainable),), jnp.float32)
+    pvals = [p._data._data for p in step._params]
+    lowered = jfn.lower(pvals, step._opt_states, jnp.asarray(1, jnp.int32),
+                        lrs, _random.next_key(), dval, lval)
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    out = {"bs": bs, "dtype": dtype, "mirror": mode,
+           "gbytes": round(cost.get("bytes accessed", 0.0) / 1e9, 2),
+           "tflops": round(cost.get("flops", 0.0) / 1e12, 3)}
+    for k, v in sorted(cost.items()):
+        if k.startswith("bytes accessed") and "operand" not in k:
+            out.setdefault("detail", {})[k] = round(v / 1e9, 2)
+    return out
+
+
+def main():
+    for bs, dt, mode in ((128, "bfloat16", None), (128, "bfloat16", "mirror"),
+                         (256, "bfloat16", "mirror")):
+        try:
+            print(json.dumps(analyze(bs, dt, mode)), flush=True)
+        except Exception as e:
+            print(json.dumps({"bs": bs, "mirror": mode,
+                              "error": repr(e)[:300]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

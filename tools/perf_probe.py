@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""One-off perf probe: ResNet-50 train step across mirror modes / batch
+sizes on the real chip.  Not part of the bench contract — a scratch tool
+for the roofline investigation (results land in bench.py defaults)."""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+import bench
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="128:bfloat16:none,128:bfloat16:mirror,"
+                    "128:bfloat16:full,256:bfloat16:mirror,64:bfloat16:mirror")
+    ap.add_argument("--iters", type=int, default=12)
+    args = ap.parse_args()
+    for cfg in args.configs.split(","):
+        bs, dt, mode = cfg.split(":")
+        mode = None if mode == "none" else mode
+        t0 = time.time()
+        try:
+            step, data, label = bench._build_train_step(
+                "resnet50_v1", int(bs), dt, mirror=mode)
+            step_s, loss = bench._time_calls(lambda: step(data, label),
+                                             bench._sync, iters=args.iters)
+            out = {"bs": int(bs), "dtype": dt, "mirror": mode,
+                   "step_ms": round(step_s * 1000, 2),
+                   "img_s": round(int(bs) / step_s, 1),
+                   "loss": round(bench._sync(loss), 3),
+                   "build_s": round(time.time() - t0, 1)}
+        except Exception as e:
+            out = {"bs": int(bs), "dtype": dt, "mirror": mode,
+                   "error": repr(e)[:300]}
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
