@@ -3,9 +3,12 @@
 unlocked by the contrib detection ops: MultiBoxPrior/Target/Detection).
 
 Trains a compact SSD — multi-scale conv feature maps, per-scale anchor
-heads — on synthetic detection data.  The full step (forward + SSD loss +
-backward + update) runs eagerly on the device; targets come from
-MultiBoxTarget on the host exactly like the reference's CPU target kernel.
+heads — on synthetic detection data.  By default the FULL step (forward +
+MultiBoxTarget assignment + SSD loss + backward + update) compiles into
+one jitted XLA program via ``DataParallelStep`` — the target op is pure
+jnp/lax, so no host callbacks are involved and the step runs on-chip
+(reference runs the same kernels on the accelerator, multibox_target.cu).
+``--eager`` keeps the per-op imperative path.
 
     python example/ssd/train_ssd.py --epochs 2
 """
@@ -99,6 +102,36 @@ def synthetic_batch(rs, batch_size, image_size, num_classes):
     return mx.nd.array(x), mx.nd.array(labels)
 
 
+class SSDLoss(gluon.loss.Loss):
+    """MultiBoxTarget assignment + class CE + location Huber, all inside
+    the traced step (the target op is jnp/lax, so this jits on TPU)."""
+
+    def __init__(self, anchors, num_classes):
+        super().__init__(weight=None, batch_axis=0)
+        self._anchors = anchors
+        self._nc = num_classes
+        self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+        self._huber = gluon.loss.HuberLoss()
+
+    def hybrid_forward(self, F, outputs, labels):
+        cls_pred, loc_pred = outputs
+        loc_t, loc_m, cls_t = F.contrib.MultiBoxTarget(
+            self._anchors, labels, cls_pred.transpose(axes=(0, 2, 1)),
+            negative_mining_ratio=3.0)
+        # targets are labels, not activations: no gradient flows back
+        # through the assignment (reference: target op has no backward)
+        loc_t, loc_m, cls_t = (F.BlockGrad(t) for t in (loc_t, loc_m,
+                                                        cls_t))
+        # anchors dropped by negative mining carry cls_target=-1 and must
+        # be EXCLUDED: mask them out (a -1 label would wrap to the last
+        # class in take_along_axis)
+        cls_mask = (cls_t >= 0).reshape(-1, 1)
+        cls_loss = self._ce(cls_pred.reshape(-1, self._nc + 1),
+                            F.maximum(cls_t, 0).reshape(-1), cls_mask)
+        loc_loss = self._huber(loc_pred * loc_m, loc_t * loc_m)
+        return cls_loss.mean() + loc_loss.mean()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=16)
@@ -108,6 +141,8 @@ def main():
     ap.add_argument("--num-classes", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--eager", action="store_true",
+                    help="per-op imperative step instead of the jitted one")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -119,12 +154,23 @@ def main():
     anchors = build_anchors(args.image_size, sizes_per_scale, ratios)
     logging.info("anchors: %s", anchors.shape)
 
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": args.lr, "momentum": 0.9,
-                             "wd": 5e-4})
-    ce = gluon.loss.SoftmaxCrossEntropyLoss()
-    huber = gluon.loss.HuberLoss()
+    loss_fn = SSDLoss(anchors.as_in_context(mx.tpu()), args.num_classes)
     rs = onp.random.RandomState(args.seed)
+
+    if not args.eager:
+        # warm-up eager forward materializes deferred shapes, then the
+        # whole train step (incl. MultiBoxTarget) compiles as ONE program
+        x0, _ = synthetic_batch(rs, args.batch_size, args.image_size,
+                                args.num_classes)
+        net(x0.as_in_context(mx.tpu()))
+        step = mx.parallel.DataParallelStep(
+            net, loss_fn,
+            mx.optimizer.SGD(learning_rate=args.lr, momentum=0.9, wd=5e-4),
+            mesh=None)
+    else:
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": args.lr, "momentum": 0.9,
+                                 "wd": 5e-4})
 
     for epoch in range(args.epochs):
         tic = time.time()
@@ -133,30 +179,22 @@ def main():
             x, labels = synthetic_batch(rs, args.batch_size,
                                         args.image_size, args.num_classes)
             x = x.as_in_context(mx.tpu())
-            with autograd.record():
-                cls_pred, loc_pred = net(x)
-                with autograd.pause():
-                    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
-                        anchors, labels,
-                        cls_pred.transpose(axes=(0, 2, 1)),
-                        negative_mining_ratio=3.0)
-                # anchors dropped by negative mining carry cls_target=-1
-                # and must be EXCLUDED: mask them out (a -1 label would
-                # wrap to the last class in take_along_axis)
-                cls_mask = (cls_t >= 0).reshape(-1, 1)
-                cls_loss = ce(cls_pred.reshape(-1, args.num_classes + 1),
-                              mx.nd.maximum(cls_t, 0).reshape(-1),
-                              cls_mask)
-                loc_loss = huber(loc_pred * loc_m, loc_t * loc_m)
-                loss = cls_loss.mean() + loc_loss.mean()
-            loss.backward()
-            trainer.step(1)
+            if not args.eager:
+                loss = step(x, labels.as_in_context(mx.tpu()))
+            else:
+                with autograd.record():
+                    outputs = net(x)
+                    loss = loss_fn(outputs, labels)
+                loss.backward()
+                trainer.step(1)
             epoch_loss += float(loss.asnumpy())
         logging.info("epoch %d: loss %.4f (%.1fs)", epoch,
                      epoch_loss / args.batches_per_epoch,
                      time.time() - tic)
 
     # decode detections for one batch (inference path)
+    if not args.eager:
+        cls_pred, loc_pred = net(x)
     probs = mx.nd.softmax(cls_pred.transpose(axes=(0, 2, 1)), axis=1)
     det = mx.nd.contrib.MultiBoxDetection(probs, loc_pred, anchors,
                                           nms_threshold=0.45)

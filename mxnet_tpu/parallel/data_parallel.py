@@ -120,6 +120,15 @@ class DataParallelStep:
             self._state_treedefs.append(treedef)
         self._t = optimizer.begin_num_update
         self._cache = {}
+        # device-resident per-call operands: a tiny host->device transfer
+        # costs milliseconds through a remote-tunnel dispatch path, so the
+        # lr vector is cached (re-uploaded only when the schedule moves),
+        # and the step counter and RNG key live on-device, threaded
+        # through the jitted step as donated carry values
+        self._lrs_key = None
+        self._lrs_dev = None
+        self._t_dev = None
+        self._rng_dev = None
 
     # ------------------------------------------------------------------
     def __call__(self, data, label):
@@ -150,13 +159,18 @@ class DataParallelStep:
         # per slot — passed traced so warmup/decay advance inside the cached
         # compiled step (the reference re-reads the schedule per update too)
         self._opt.num_update = max(self._opt.num_update, self._t)
-        lrs = jnp.asarray(
-            self._opt._get_lrs(list(range(len(self._trainable)))), jnp.float32)
+        lr_vals = tuple(self._opt._get_lrs(list(range(len(self._trainable)))))
+        if lr_vals != self._lrs_key:
+            self._lrs_dev = jnp.asarray(lr_vals, jnp.float32)
+            self._lrs_key = lr_vals
+        if self._t_dev is None:
+            self._t_dev = jnp.asarray(self._t, jnp.int32)
+        if self._rng_dev is None:
+            self._rng_dev = _random.next_key()
         pvals = [p._data._data for p in self._params]
-        rng = _random.next_key()
-        new_pvals, new_states, loss = jfn(
-            pvals, self._opt_states, jnp.asarray(self._t, jnp.int32), lrs, rng,
-            dval, lval)
+        new_pvals, new_states, self._t_dev, self._rng_dev, loss = jfn(
+            pvals, self._opt_states, self._t_dev, self._lrs_dev,
+            self._rng_dev, dval, lval)
         for p, v in zip(self._params, new_pvals):
             with autograd.pause():
                 p._data._data = v
@@ -211,13 +225,17 @@ class DataParallelStep:
         fwd = _mirror_wrap(run_forward, self._mirror)
 
         def step_fn(pvals, opt_states, t, lrs, rng, dval, lval):
+            # the step counter and RNG key are device-resident carries:
+            # advanced inside the program, returned for the next call (no
+            # per-step host->device transfer)
+            use_key, next_key = jax.random.split(rng)
             train_vals = [pvals[i] for i in trainable]
 
             def loss_of(tvals):
                 full = list(pvals)
                 for i, v in zip(trainable, tvals):
                     full[i] = v
-                return fwd(full, rng, dval, lval)
+                return fwd(full, use_key, dval, lval)
 
             (loss_val, mutated), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_vals)
@@ -234,7 +252,7 @@ class DataParallelStep:
                 new_states.append(list(res[1:]))
             for i, v in mutated.items():
                 new_pvals[i] = v
-            return new_pvals, new_states, loss_val
+            return new_pvals, new_states, t + 1, next_key, loss_val
 
-        donate = (0, 1) if self._donate else ()
+        donate = (0, 1, 2, 4) if self._donate else ()
         return jax.jit(step_fn, donate_argnums=donate)

@@ -214,6 +214,9 @@ class PipelineTrainer:
                                  for s in st_leaves])
         self._t = 0
         self._jitted = {}
+        self._lr_key = None
+        self._lr_dev = None
+        self._t_dev = None
 
     def _build(self):
         fns, treedef, axis, mesh = (self._fns, self._treedef, self._axis,
@@ -232,9 +235,9 @@ class PipelineTrainer:
                 res = steps[i](w, g, t, lr.astype(w.dtype), *states[i])
                 new_leaves.append(res[0])
                 new_states.append(list(res[1:]))
-            return new_leaves, new_states, loss
+            return new_leaves, new_states, t + 1, loss
 
-        return jax.jit(step_fn, donate_argnums=(0, 1))
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     def step(self, inputs, labels):
         key = (tuple(inputs.shape), str(inputs.dtype),
@@ -244,9 +247,16 @@ class PipelineTrainer:
             jfn = self._jitted[key] = self._build()
         self._t += 1
         self._opt.num_update = max(self._opt.num_update, self._t)
-        lr = jnp.asarray(self._opt._get_lrs([0])[0], jnp.float32)
-        self.params, self._states, loss = jfn(
-            self.params, self._states, jnp.asarray(self._t, jnp.int32), lr,
+        # device-resident lr/step-counter (tiny per-call uploads cost ms
+        # through a tunnel dispatch path; see DataParallelStep)
+        lr_val = float(self._opt._get_lrs([0])[0])
+        if lr_val != self._lr_key:
+            self._lr_dev = jnp.asarray(lr_val, jnp.float32)
+            self._lr_key = lr_val
+        if self._t_dev is None:
+            self._t_dev = jnp.asarray(self._t, jnp.int32)
+        self.params, self._states, self._t_dev, loss = jfn(
+            self.params, self._states, self._t_dev, self._lr_dev,
             inputs, labels)
         return loss
 

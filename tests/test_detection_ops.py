@@ -227,3 +227,130 @@ def test_psroi_pooling_group_differs_from_pooled():
     got = out.asnumpy()
     want22 = data[0, 3, 4:6, 4:6].mean()  # bin_w = 8/4 = 2 -> rows 4..5
     onp.testing.assert_allclose(got[0, 0, 2, 2], want22, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# device (jnp/lax) path == sequential numpy oracle, under jit
+# ---------------------------------------------------------------------------
+
+def _rand_targets_case(seed, B=2, N=40, M=6, C=4):
+    rs = onp.random.RandomState(seed)
+    a = rs.uniform(0, 0.7, (1, N, 2)).astype("float32")
+    anchors = onp.concatenate([a, a + rs.uniform(0.05, 0.3, a.shape)
+                               .astype("float32")], axis=2)
+    labels = onp.full((B, M, 5), -1.0, "float32")
+    for b in range(B):
+        k = rs.randint(1, M)
+        xy = rs.uniform(0, 0.6, (k, 2))
+        wh = rs.uniform(0.1, 0.4, (k, 2))
+        labels[b, :k, 0] = rs.randint(0, C - 1, k)
+        labels[b, :k, 1:3] = xy
+        labels[b, :k, 3:5] = xy + wh
+    cls_preds = rs.randn(B, C, N).astype("float32")
+    return anchors, labels, cls_preds
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("mining", [-1.0, 3.0])
+def test_multibox_target_device_matches_host_oracle(seed, mining):
+    import jax
+    from mxnet_tpu.ops import detection as D
+    anchors, labels, cls_preds = _rand_targets_case(seed)
+    kw = dict(overlap_threshold=0.45, negative_mining_ratio=mining,
+              negative_mining_thresh=0.5)
+    got = jax.jit(lambda a, l, p: D.multibox_target(a, l, p, **kw))(
+        anchors, labels, cls_preds)
+    want = D.multibox_target_host(anchors, labels, cls_preds, **kw)
+    for g, w, name in zip(got, want, ("loc_t", "loc_m", "cls_t")):
+        onp.testing.assert_allclose(onp.asarray(g), w, rtol=1e-5,
+                                    atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+@pytest.mark.parametrize("force", [False, True])
+def test_multibox_detection_device_matches_host_oracle(seed, force):
+    import jax
+    from mxnet_tpu.ops import detection as D
+    rs = onp.random.RandomState(seed)
+    B, C, N = 2, 4, 30
+    a = rs.uniform(0, 0.7, (1, N, 2)).astype("float32")
+    anchors = onp.concatenate([a, a + rs.uniform(0.05, 0.3, a.shape)
+                               .astype("float32")], axis=2)
+    logits = rs.randn(B, C, N).astype("float32")
+    cls_prob = onp.exp(logits) / onp.exp(logits).sum(1, keepdims=True)
+    loc_pred = (rs.randn(B, 4 * N) * 0.2).astype("float32")
+    kw = dict(threshold=0.1, nms_threshold=0.45, force_suppress=force,
+              nms_topk=20)
+    got = jax.jit(lambda p, l, a: D.multibox_detection(p, l, a, **kw))(
+        cls_prob, loc_pred, anchors)
+    want = D.multibox_detection_host(cls_prob, loc_pred, anchors, **kw)
+    onp.testing.assert_allclose(onp.asarray(got), want, rtol=1e-4,
+                                atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_proposal_device_matches_host_oracle(seed):
+    import jax
+    from mxnet_tpu.ops import detection as D
+    rs = onp.random.RandomState(seed)
+    B, H, W = 2, 4, 5
+    scales, ratios = (8, 16), (0.5, 1.0, 2.0)
+    A = len(scales) * len(ratios)
+    cls_prob = rs.uniform(0, 1, (B, 2 * A, H, W)).astype("float32")
+    bbox_pred = (rs.randn(B, 4 * A, H, W) * 0.3).astype("float32")
+    im_info = onp.array([[64.0, 80.0, 1.0], [60.0, 60.0, 2.0]], "float32")
+    kw = dict(rpn_pre_nms_top_n=40, rpn_post_nms_top_n=8, threshold=0.6,
+              rpn_min_size=8, scales=scales, ratios=ratios,
+              feature_stride=16)
+    rois, scores = jax.jit(lambda c, b, i: D.proposal(
+        c, b, i, output_score=True, **kw))(cls_prob, bbox_pred, im_info)
+    wr, ws = D.proposal_host(cls_prob, bbox_pred, im_info, **kw)
+    onp.testing.assert_allclose(onp.asarray(rois), wr, rtol=1e-4,
+                                atol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(scores), ws, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_ssd_train_step_jits_without_callbacks():
+    """The SSD train step (MultiBoxTarget inside the loss) compiles and
+    runs fully under jit — no host callbacks (required on TPU)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import detection as D
+    anchors, labels, _ = _rand_targets_case(9, B=2, N=24, M=4, C=3)
+
+    def step(conv_feat, labels):
+        # toy heads: cls (B,C,N) and loc (B,4N) from a fake feature
+        cls = jnp.tanh(conv_feat[:, :3 * 24]).reshape(2, 3, 24)
+        loc = jnp.tanh(conv_feat[:, :4 * 24])
+        loc_t, loc_m, cls_t = D.multibox_target(anchors, labels, cls)
+        loc_l = jnp.sum(loc_m * jnp.abs(loc - loc_t))
+        ce = -jax.nn.log_softmax(cls, axis=1)
+        cls_l = jnp.mean(jnp.take_along_axis(
+            ce, cls_t[:, None].astype(jnp.int32), axis=1))
+        return loc_l + cls_l
+
+    feat = onp.random.RandomState(11).randn(2, 96).astype("float32")
+    loss, grad = jax.jit(jax.value_and_grad(step))(feat, labels)
+    assert onp.isfinite(float(loss))
+    assert onp.isfinite(onp.asarray(grad)).all()
+
+
+def test_multibox_target_no_gt_image_is_all_background():
+    """An object-free image (all labels -1) must produce all-background
+    cls targets even with mining on — never all-ignore (regression:
+    device path left flags at -1, silently zeroing the image's
+    classification loss)."""
+    import jax
+    from mxnet_tpu.ops import detection as D
+    anchors, labels, cls_preds = _rand_targets_case(13, B=3)
+    labels[1, :, :] = -1.0                 # middle image has no objects
+    kw = dict(negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    got = jax.jit(lambda a, l, p: D.multibox_target(a, l, p, **kw))(
+        anchors, labels, cls_preds)
+    want = D.multibox_target_host(anchors, labels, cls_preds, **kw)
+    for g, w, name in zip(got, want, ("loc_t", "loc_m", "cls_t")):
+        onp.testing.assert_allclose(onp.asarray(g), w, rtol=1e-5,
+                                    atol=1e-6, err_msg=name)
+    onp.testing.assert_array_equal(onp.asarray(got[2])[1],
+                                   onp.zeros(labels.shape[0] and 40))

@@ -9,28 +9,10 @@ import bench
 
 
 def analyze(bs, dtype, mode):
-    import jax
-    import mxnet_tpu as mx
     step, data, label = bench._build_train_step("resnet50_v1", bs, dtype,
                                                 mirror=mode)
-    # reach the inner jitted fn the way __call__ does, then lower it
-    import jax.numpy as jnp
-    from mxnet_tpu import random as _random
-    dval, lval = data._data, label._data
-    jfn = step._build()          # the jax.jit-wrapped step
-    lrs = jnp.zeros((len(step._trainable),), jnp.float32)
-    pvals = [p._data._data for p in step._params]
-    lowered = jfn.lower(pvals, step._opt_states, jnp.asarray(1, jnp.int32),
-                        lrs, _random.next_key(), dval, lval)
-    cost = lowered.compile().cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    out = {"bs": bs, "dtype": dtype, "mirror": mode,
-           "gbytes": round(cost.get("bytes accessed", 0.0) / 1e9, 2),
-           "tflops": round(cost.get("flops", 0.0) / 1e12, 3)}
-    for k, v in sorted(cost.items()):
-        if k.startswith("bytes accessed") and "operand" not in k:
-            out.setdefault("detail", {})[k] = round(v / 1e9, 2)
+    out = {"bs": bs, "dtype": dtype, "mirror": mode}
+    out.update(bench._step_cost_analysis(step, data, label, step_s=1.0))
     return out
 
 
