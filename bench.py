@@ -64,7 +64,7 @@ PEAK_BF16_FLOPS = 197e12
 PEAK_HBM_BYTES = 819e9
 
 
-def _step_cost_analysis(step, data, label, step_s):
+def _step_cost_analysis(step, data, label, step_s=None):
     """XLA cost/memory analysis of the compiled train step + roofline
     floors.  ``xla_logical_gb`` is bytes_accessed — it counts fused
     re-reads, so it is an UPPER bound on physical HBM DMA (the r3 bench
@@ -89,12 +89,13 @@ def _step_cost_analysis(step, data, label, step_s):
         "xla_logical_gb": round(gb, 2),
         "xla_tflops": round(tf, 3),
         "compute_floor_ms": round(tf / (PEAK_BF16_FLOPS / 1e12) * 1000, 2),
+    }
+    if step_s:
         # sustained rate implied by logical bytes, capped at the physical
         # spec — "at least this close to saturation", never >100%
-        "hbm_util_upper_capped": round(
+        out["hbm_util_upper_capped"] = round(
             min(gb / step_s, PEAK_HBM_BYTES / 1e9) / (PEAK_HBM_BYTES / 1e9),
-            3),
-    }
+            3)
     try:
         mem = compiled.memory_analysis()
         out["live_temp_gb"] = round(mem.temp_size_in_bytes / 1e9, 3)

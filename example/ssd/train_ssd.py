@@ -193,8 +193,7 @@ def main():
                      time.time() - tic)
 
     # decode detections for one batch (inference path)
-    if not args.eager:
-        cls_pred, loc_pred = net(x)
+    cls_pred, loc_pred = net(x)
     probs = mx.nd.softmax(cls_pred.transpose(axes=(0, 2, 1)), axis=1)
     det = mx.nd.contrib.MultiBoxDetection(probs, loc_pred, anchors,
                                           nms_threshold=0.45)

@@ -16,6 +16,7 @@ from .ndarray import (  # noqa: F401
 from .utils import save, load  # noqa: F401
 from . import contrib  # noqa: F401
 from . import sparse  # noqa: F401
+from . import random  # noqa: F401
 
 _FUNC_CACHE = {}
 

@@ -1,0 +1,43 @@
+"""``mx.nd.random`` namespace (reference ``python/mxnet/ndarray/random.py``):
+draw-from-distribution helpers forwarding to the registered sampling ops
+(``ops/random_ops.py``), which thread the global functional PRNG key.
+"""
+from __future__ import annotations
+
+__all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle"]
+
+# public name -> registered op name
+_FORWARD = {
+    "uniform": "random_uniform",
+    "normal": "random_normal",
+    "randint": "random_randint",
+    "gamma": "random_gamma",
+    "exponential": "random_exponential",
+    "poisson": "random_poisson",
+    "negative_binomial": "random_negative_binomial",
+    "generalized_negative_binomial": "random_generalized_negative_binomial",
+    "multinomial": "sample_multinomial",
+    "shuffle": "shuffle",
+}
+
+
+def _op(name):
+    from .. import ndarray as _nd
+    return getattr(_nd, _FORWARD[name])
+
+
+def __getattr__(name):
+    if name in _FORWARD:
+        return _op(name)
+    raise AttributeError("module 'ndarray.random' has no attribute %r"
+                         % name)
+
+
+def randn(*shape, **kwargs):
+    """Standard-normal samples of the given shape (reference random.py
+    randn): ``randn(2, 3)`` == ``normal(0, 1, shape=(2, 3))``."""
+    loc = kwargs.pop("loc", 0.0)
+    scale = kwargs.pop("scale", 1.0)
+    return _op("normal")(loc, scale, shape=shape, **kwargs)

@@ -129,6 +129,7 @@ class DataParallelStep:
         self._lrs_dev = None
         self._t_dev = None
         self._rng_dev = None
+        self._rng_epoch = None
 
     # ------------------------------------------------------------------
     def __call__(self, data, label):
@@ -165,8 +166,11 @@ class DataParallelStep:
             self._lrs_key = lr_vals
         if self._t_dev is None:
             self._t_dev = jnp.asarray(self._t, jnp.int32)
-        if self._rng_dev is None:
+        if self._rng_dev is None or self._rng_epoch != _random.seed_epoch():
+            # (re-)draw from the global stream — a fresh mx.random.seed()
+            # must restart this step's dropout trajectory too
             self._rng_dev = _random.next_key()
+            self._rng_epoch = _random.seed_epoch()
         pvals = [p._data._data for p in self._params]
         new_pvals, new_states, self._t_dev, self._rng_dev, loss = jfn(
             pvals, self._opt_states, self._t_dev, self._lrs_dev,

@@ -38,6 +38,14 @@ def seed(seed_state: int, ctx: str = "all") -> None:
     """Seed the global RNG (reference ``mx.random.seed``; ctx accepted for
     API parity — all devices share one functional key stream here)."""
     _STATE.key = jax.random.PRNGKey(int(seed_state))
+    _STATE.epoch = getattr(_STATE, "epoch", 0) + 1
+
+
+def seed_epoch() -> int:
+    """Bumped on every ``seed()`` call — lets key-carrying consumers
+    (e.g. DataParallelStep's on-device RNG carry) notice a reseed and
+    re-draw from the global stream."""
+    return getattr(_STATE, "epoch", 0)
 
 
 def next_key() -> jax.Array:
@@ -72,3 +80,13 @@ class key_supply:
 
 def current_key_supplier() -> Optional[Callable]:
     return _STATE.suppliers[-1] if _STATE.suppliers else None
+
+
+def __getattr__(name):
+    # distribution draws forward to the nd.random namespace (reference
+    # python/mxnet/random.py re-exports ndarray/random.py the same way)
+    from .ndarray import random as _ndrandom
+    try:
+        return getattr(_ndrandom, name)
+    except AttributeError:
+        raise AttributeError("module 'random' has no attribute %r" % name)

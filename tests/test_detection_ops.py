@@ -353,4 +353,4 @@ def test_multibox_target_no_gt_image_is_all_background():
         onp.testing.assert_allclose(onp.asarray(g), w, rtol=1e-5,
                                     atol=1e-6, err_msg=name)
     onp.testing.assert_array_equal(onp.asarray(got[2])[1],
-                                   onp.zeros(labels.shape[0] and 40))
+                                   onp.zeros(anchors.shape[1]))

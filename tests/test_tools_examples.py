@@ -153,3 +153,31 @@ def test_bert_pretrain_example_descends():
     assert final, out.stdout[-400:]
     first = float(lines[0].split("mlm loss")[1].split()[0])
     assert float(final[0].split()[1]) < first, (lines, final)
+
+
+def test_quantization_example():
+    """example/quantization: int8 rewrite keeps the toy accuracy."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", "quantization",
+                                      "quantize_model.py"),
+         "--epochs", "6"],
+        env=ENV, capture_output=True, text=True, timeout=480)
+    assert out.returncode == 0, out.stderr[-800:]
+    accs = dict(l.split() for l in out.stdout.splitlines()
+                if l.startswith(("FP32_ACC", "INT8_ACC")))
+    assert float(accs["FP32_ACC"]) > 0.9, accs
+    assert float(accs["INT8_ACC"]) > 0.85, accs
+
+
+def test_distributed_training_example():
+    """example/distributed_training through the real launcher: 2 OS
+    processes, dist_sync kvstore, both ranks converge."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import launch
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    script = os.path.join(REPO, "example", "distributed_training",
+                          "train_dist.py")
+    codes = launch.launch_local(2, [sys.executable, script,
+                                    "--epochs", "12"], env=env)
+    assert codes == [0, 0], codes

@@ -12,7 +12,7 @@ def analyze(bs, dtype, mode):
     step, data, label = bench._build_train_step("resnet50_v1", bs, dtype,
                                                 mirror=mode)
     out = {"bs": bs, "dtype": dtype, "mirror": mode}
-    out.update(bench._step_cost_analysis(step, data, label, step_s=1.0))
+    out.update(bench._step_cost_analysis(step, data, label))
     return out
 
 
