@@ -29,6 +29,7 @@ class _RngState(threading.local):
         # jax.distributed.initialize for multi-process jobs)
         self.key: Optional[jax.Array] = None
         self.suppliers: List[Callable[[], jax.Array]] = []
+        self.epoch = 0
 
 
 _STATE = _RngState()
@@ -38,14 +39,14 @@ def seed(seed_state: int, ctx: str = "all") -> None:
     """Seed the global RNG (reference ``mx.random.seed``; ctx accepted for
     API parity — all devices share one functional key stream here)."""
     _STATE.key = jax.random.PRNGKey(int(seed_state))
-    _STATE.epoch = getattr(_STATE, "epoch", 0) + 1
+    _STATE.epoch += 1
 
 
 def seed_epoch() -> int:
     """Bumped on every ``seed()`` call — lets key-carrying consumers
     (e.g. DataParallelStep's on-device RNG carry) notice a reseed and
     re-draw from the global stream."""
-    return getattr(_STATE, "epoch", 0)
+    return _STATE.epoch
 
 
 def next_key() -> jax.Array:

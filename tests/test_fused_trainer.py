@@ -104,3 +104,32 @@ def test_fused_save_load_states_roundtrip():
         t.save_states(fname)
         t.load_states(fname)
     _train_steps(net, x, t, n=1)
+
+
+def test_reseed_restarts_step_rng_trajectory():
+    """mx.random.seed() mid-run must restart the compiled step's
+    on-device RNG carry: identical seeds => identical dropout/loss
+    trajectories (regression: the carried key once ignored reseeds)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    def run(step_holder={}):
+        mx.random.seed(11)
+        onp.random.seed(1)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dropout(0.5),
+                nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(8, 6).astype("float32"))
+        y = mx.nd.array(onp.random.randint(0, 4, (8,)).astype("float32"))
+        net(x)
+        step = mx.parallel.DataParallelStep(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            mx.optimizer.SGD(learning_rate=0.1), mesh=None)
+        return [float(step(x, y).asnumpy()) for _ in range(3)]
+
+    first = run()
+    second = run()
+    assert first == second, (first, second)
