@@ -115,7 +115,7 @@ def test_reseed_restarts_step_rng_trajectory():
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon import nn
 
-    def run(step_holder={}):
+    def run():
         mx.random.seed(11)
         onp.random.seed(1)
         net = nn.HybridSequential()

@@ -181,3 +181,20 @@ def test_distributed_training_example():
     codes = launch.launch_local(2, [sys.executable, script,
                                     "--epochs", "12"], env=env)
     assert codes == [0, 0], codes
+
+
+def test_dcgan_example_runs():
+    """example/gan/dcgan.py: adversarial training through the
+    Conv2DTranspose generator runs and the generator leaves its
+    constant-output init (reference example/gan capability)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", "gan", "dcgan.py"),
+         "--epochs", "3", "--batches-per-epoch", "6", "--batch-size", "16"],
+        env=ENV, capture_output=True, text=True, timeout=480)
+    assert out.returncode == 0, out.stderr[-800:]
+    final = [l for l in out.stdout.splitlines() if l.startswith("FINAL_D")]
+    assert final, out.stdout[-300:]
+    parts = final[0].split()
+    d_loss, g_loss, std = float(parts[1]), float(parts[3]), float(parts[5])
+    assert onp.isfinite(d_loss) and onp.isfinite(g_loss)
+    assert std > 0.02, "generator collapsed to a constant: std=%s" % std
