@@ -39,6 +39,7 @@ from .ring_attention import (  # noqa: F401
     blockwise_attention, ring_attention, ring_attention_sharded)
 from .pipeline import (pipeline_apply, pipeline_train_step,  # noqa: F401
                        PipelineTrainer)
+from .moe import moe_ffn_init, moe_ffn_apply, moe_ffn_ref  # noqa: F401
 
 __all__ = [
     "Mesh", "NamedSharding", "P",
@@ -49,6 +50,9 @@ __all__ = [
     "pipeline_apply",
     "pipeline_train_step",
     "PipelineTrainer",
+    "moe_ffn_init",
+    "moe_ffn_apply",
+    "moe_ffn_ref",
 ]
 
 

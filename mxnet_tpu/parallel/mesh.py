@@ -14,7 +14,22 @@ import jax
 import numpy as onp
 from jax.sharding import Mesh
 
-__all__ = ["set_mesh", "get_mesh", "current_mesh", "default_mesh", "device_mesh"]
+__all__ = ["set_mesh", "get_mesh", "current_mesh", "default_mesh",
+           "device_mesh", "shard_map_compat"]
+
+
+def shard_map_compat(fn, **kwargs):
+    """shard_map across jax spellings (top-level vs experimental; the
+    replication-check kwarg renamed check_rep→check_vma) — the one shim
+    every mesh-sharded component (pipeline, MoE, ring attention) uses."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:  # older jax spelling
+        return shard_map(fn, check_rep=False, **kwargs)
 
 
 class _MeshState(threading.local):

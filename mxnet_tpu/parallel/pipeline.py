@@ -30,15 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 __all__ = ["pipeline_apply", "pipeline_train_step", "PipelineTrainer"]
 
 
-def _shard_map(fn, **kwargs):
-    try:
-        from jax import shard_map  # jax >= 0.8: top-level function
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-    try:
-        return shard_map(fn, check_vma=False, **kwargs)
-    except TypeError:  # older jax spelling
-        return shard_map(fn, check_rep=False, **kwargs)
+from .mesh import shard_map_compat as _shard_map  # noqa: E402
 
 
 def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh,
@@ -49,11 +41,6 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh,
     activations to activations of the same shape — true for transformer
     blocks and most residual stages; reshape layers belong inside a stage).
     """
-    try:
-        from jax import shard_map  # jax >= 0.8: top-level function
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-
     nstage = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != nstage:

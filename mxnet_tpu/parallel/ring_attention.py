@@ -155,11 +155,7 @@ def ring_attention_sharded(q, k, v, mesh=None, axis: str = "sp",
     """Convenience wrapper: shard_map ``ring_attention`` over ``mesh[axis]``
     with Q/K/V sequence-sharded — the user-facing CP entry point."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-    from .mesh import default_mesh
+    from .mesh import default_mesh, shard_map_compat
     from ..ndarray import NDArray
     from ..ndarray.ndarray import _wrap
 
@@ -168,7 +164,7 @@ def ring_attention_sharded(q, k, v, mesh=None, axis: str = "sp",
     qv, kv_, vv = unwrap(q), unwrap(k), unwrap(v)
     spec = P(None, None, axis, None)
 
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name=axis, causal=causal,
                           scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
