@@ -22,18 +22,20 @@ Prints ONE JSON line:
     {"metric": ..., "value": ..., "unit": "img/s", "vs_baseline": ...,
      "detail": {...}}
 
-Performance note (round 4): ResNet-50 training on one v5e chip is bound
-by MATERIALIZED-ACTIVATION traffic, not MXU FLOPs.  The decisive
-experiment: backward-mirror remat (MXNET_BACKWARD_DO_MIRROR=1) RAISES
-XLA's logical work (bytes_accessed 44.5→50.1 GB, flops ~const at bs=128
-bf16) yet CUTS step time ~20% — because it shrinks the live intermediate
-set XLA must round-trip through HBM (memory_analysis temp bytes, the
-`live_temp_gb` field).  Logical bytes_accessed counts fused re-reads, so
-it is only an UPPER bound on physical DMA; the bench therefore reports
+Performance note (round 4, profiled): the ResNet-50 bf16 train step is a
+two-regime program — ~58% of device time is convolutions running
+compute-limited at ~47% MXU efficiency (shape/layout bound, their DMA is
+only ~290 GB/s), and the rest is elementwise/BN/residual fusions running
+bandwidth-saturated.  Backward-mirror remat (MXNET_BACKWARD_DO_MIRROR=1)
+attacks the second regime: it RAISES logical work (bytes_accessed
+44.5→50.1 GB at bs=128) yet CUTS step time ~20%, because the live
+intermediate set XLA round-trips through HBM shrinks 4.48→3.33 GB
+(memory_analysis, the `live_temp_gb` field).  Logical bytes_accessed
+counts in-fusion re-reads (summing it implies >spec bandwidth), so it is
+only an UPPER bound on physical DMA; the bench reports
 `hbm_util_upper_capped` = min(logical-rate, spec)/spec — "at least this
 close to saturation" — instead of round 3's >spec "sustained" figure.
-MFU stays structurally low for this model class (compute floor ~15 ms of
-a ~50-60 ms step); bf16 train configs default to mirror mode.
+bf16 train configs default to mirror mode.
 
 Usage:
     python bench.py             # headline + inference, minutes
@@ -520,6 +522,11 @@ def smoke():
 
 
 def main():
+    # executable reuse across runs: the bench's wall time is dominated by
+    # XLA compiles, which the persistent cache eliminates on repeats
+    from mxnet_tpu.engine import enable_compilation_cache
+    enable_compilation_cache()
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50_v1")
     ap.add_argument("--batch-size", type=int, default=64)

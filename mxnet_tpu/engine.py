@@ -18,7 +18,8 @@ from __future__ import annotations
 import contextlib
 import os
 
-__all__ = ["bulk", "set_bulk_size", "naive_engine", "engine_type"]
+__all__ = ["bulk", "set_bulk_size", "naive_engine", "engine_type",
+           "enable_compilation_cache"]
 
 _BULK_SIZE = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", 15))
 
@@ -69,3 +70,25 @@ def _apply_env_engine_type():
 
 
 _apply_env_engine_type()
+
+
+def enable_compilation_cache(path=None):
+    """Persistent XLA executable cache (the TPU analogue of the
+    reference's cuDNN autotune cache + graph-plan reuse): compiled
+    programs are keyed by HLO and reused across PROCESSES, so repeat
+    runs of benches/tests/training scripts skip their multi-second
+    compiles.  Safe to call multiple times; failures (read-only fs,
+    unsupported backend) degrade to normal compilation."""
+    import jax
+    path = path or os.environ.get("MXNET_TPU_COMPILATION_CACHE")
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return path
+    except Exception:
+        return None

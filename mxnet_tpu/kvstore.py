@@ -394,7 +394,7 @@ def _cross_process_packed_reducer(npacked, n, shape, dtype_str, threshold):
     from jax import lax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from .gradient_compression import unpack_2bit
-    from .parallel.pipeline import _shard_map
+    from .parallel.mesh import shard_map_compat as _shard_map
 
     nproc = jax.process_count()
     per_proc = len(jax.local_devices())

@@ -17,6 +17,7 @@ from .utils import save, load  # noqa: F401
 from . import contrib  # noqa: F401
 from . import sparse  # noqa: F401
 from . import random  # noqa: F401
+from . import linalg  # noqa: F401
 
 _FUNC_CACHE = {}
 

@@ -1,0 +1,15 @@
+"""``mx.nd.linalg`` namespace (reference ``python/mxnet/ndarray/linalg.py``):
+short spellings forwarding to the registered ``linalg_*`` operators."""
+from __future__ import annotations
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+           "sumlogdiag", "extractdiag", "makediag", "inverse", "det",
+           "slogdet"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from .. import ndarray as _nd
+        return getattr(_nd, "linalg_" + name)
+    raise AttributeError("module 'ndarray.linalg' has no attribute %r"
+                         % name)

@@ -21,15 +21,16 @@ __all__ = ["set_mesh", "get_mesh", "current_mesh", "default_mesh",
 def shard_map_compat(fn, **kwargs):
     """shard_map across jax spellings (top-level vs experimental; the
     replication-check kwarg renamed check_rep→check_vma) — the one shim
-    every mesh-sharded component (pipeline, MoE, ring attention) uses."""
+    every mesh-sharded component (pipeline, MoE, ring attention, packed
+    kvstore push) uses."""
+    import inspect
     try:
         from jax import shard_map
     except ImportError:
         from jax.experimental.shard_map import shard_map
-    try:
-        return shard_map(fn, check_vma=False, **kwargs)
-    except TypeError:  # older jax spelling
-        return shard_map(fn, check_rep=False, **kwargs)
+    params = inspect.signature(shard_map).parameters
+    check_kw = "check_vma" if "check_vma" in params else "check_rep"
+    return shard_map(fn, **{check_kw: False}, **kwargs)
 
 
 class _MeshState(threading.local):

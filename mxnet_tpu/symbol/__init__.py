@@ -219,3 +219,5 @@ def __dir__():
 
 
 from . import contrib  # noqa: F401,E402  (namespace, mirrors mx.nd.contrib)
+from . import linalg  # noqa: F401,E402
+from . import random  # noqa: F401,E402

@@ -1,0 +1,15 @@
+"""``mx.sym.linalg`` namespace (reference ``python/mxnet/symbol/linalg.py``):
+short spellings forwarding to the registered ``linalg_*`` operators."""
+from __future__ import annotations
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+           "sumlogdiag", "extractdiag", "makediag", "inverse", "det",
+           "slogdet"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from .. import symbol as _sym
+        return getattr(_sym, "linalg_" + name)
+    raise AttributeError("module 'symbol.linalg' has no attribute %r"
+                         % name)

@@ -1,0 +1,28 @@
+"""``mx.sym.random`` namespace (reference ``python/mxnet/symbol/random.py``):
+distribution draws as graph nodes, forwarding to the sampling ops."""
+from __future__ import annotations
+
+__all__ = ["uniform", "normal", "randint", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle"]
+
+_FORWARD = {
+    "uniform": "random_uniform",
+    "normal": "random_normal",
+    "randint": "random_randint",
+    "gamma": "random_gamma",
+    "exponential": "random_exponential",
+    "poisson": "random_poisson",
+    "negative_binomial": "random_negative_binomial",
+    "generalized_negative_binomial": "random_generalized_negative_binomial",
+    "multinomial": "sample_multinomial",
+    "shuffle": "shuffle",
+}
+
+
+def __getattr__(name):
+    if name in _FORWARD:
+        from .. import symbol as _sym
+        return getattr(_sym, _FORWARD[name])
+    raise AttributeError("module 'symbol.random' has no attribute %r"
+                         % name)

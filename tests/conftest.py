@@ -20,6 +20,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# reuse compiled executables across test runs (compiles dominate the
+# suite's wall time; the cache is keyed by HLO so it is semantics-safe)
+from mxnet_tpu.engine import enable_compilation_cache  # noqa: E402
+enable_compilation_cache()
+
 import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
 

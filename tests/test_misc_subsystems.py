@@ -79,3 +79,27 @@ def test_engine_knobs():
     with mx.engine.bulk(32):
         pass
     mx.engine.set_bulk_size(prev)
+
+
+def test_namespace_submodules_forward():
+    """mx.nd.random / mx.nd.linalg / mx.sym.random / mx.sym.linalg mirror
+    the upstream module layout (reference python/mxnet/ndarray/{random,
+    linalg}.py and symbol twins)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym
+
+    mx.random.seed(0)
+    assert mx.nd.random.normal(0, 1, (2, 3)).shape == (2, 3)
+    assert mx.nd.random.randn(4, 2).shape == (4, 2)
+    assert mx.random.uniform(0, 1, (3,)).shape == (3,)
+
+    a = mx.nd.array(onp.eye(3, dtype="float32") * 4)
+    onp.testing.assert_allclose(mx.nd.linalg.potrf(a).asnumpy(),
+                                onp.eye(3) * 2, rtol=1e-5)
+    x = sym.var("x")
+    det = sym.linalg.det(x)
+    got = det.eval_imperative({"x": a})
+    assert abs(float(got.asnumpy()) - 64.0) < 1e-3
+    assert sym.random.uniform(0, 1, shape=(2, 2)).eval_imperative(
+        {}).shape == (2, 2)
