@@ -231,12 +231,23 @@ class _CustomRunner:
 
         def fwd_call(*ins):
             import jax.core as _jcore
-            if not any(isinstance(a, _jcore.Tracer) for a in ins)                     and not _callbacks_supported():
+            traced = any(isinstance(a, _jcore.Tracer) for a in ins)
+            if not traced and not _callbacks_supported():
                 # backend without host-callback support (e.g. tunneled dev
                 # chips): eager host roundtrip, gradients via the tape's
                 # _host_vjp hook instead of a traced callback
                 host = host_forward(*[onp.asarray(a) for a in ins])
                 return tuple(jax.device_put(h) for h in host)
+            if traced and not _callbacks_supported():
+                # fail at TRACE time with an actionable message rather
+                # than letting the backend reject the compiled program
+                # (the probe runs on concrete args, safe mid-trace)
+                raise MXNetError(
+                    "CustomOp %r reached a jit trace, but this backend "
+                    "does not support host callbacks inside compiled "
+                    "programs; run the op eagerly (un-hybridize the "
+                    "block, or keep the custom op outside the jitted "
+                    "step)" % (op_type,))
             return jax.pure_callback(host_forward, out_struct, *ins,
                                      vmap_method="sequential")
 

@@ -1,10 +1,9 @@
 """``mx.sym.linalg`` namespace (reference ``python/mxnet/symbol/linalg.py``):
-short spellings forwarding to the registered ``linalg_*`` operators."""
+short spellings forwarding to the registered ``linalg_*`` operators.  The
+name list is shared with the ``mx.nd.linalg`` twin."""
 from __future__ import annotations
 
-__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
-           "sumlogdiag", "extractdiag", "makediag", "inverse", "det",
-           "slogdet"]
+from ..ndarray.linalg import __all__  # noqa: F401  (same surface)
 
 
 def __getattr__(name):

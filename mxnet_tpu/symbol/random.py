@@ -1,23 +1,11 @@
 """``mx.sym.random`` namespace (reference ``python/mxnet/symbol/random.py``):
-distribution draws as graph nodes, forwarding to the sampling ops."""
+distribution draws as graph nodes, forwarding to the sampling ops.  The
+name→op table is shared with the ``mx.nd.random`` twin."""
 from __future__ import annotations
 
-__all__ = ["uniform", "normal", "randint", "gamma", "exponential",
-           "poisson", "negative_binomial", "generalized_negative_binomial",
-           "multinomial", "shuffle"]
+from ..ndarray.random import _FORWARD
 
-_FORWARD = {
-    "uniform": "random_uniform",
-    "normal": "random_normal",
-    "randint": "random_randint",
-    "gamma": "random_gamma",
-    "exponential": "random_exponential",
-    "poisson": "random_poisson",
-    "negative_binomial": "random_negative_binomial",
-    "generalized_negative_binomial": "random_generalized_negative_binomial",
-    "multinomial": "sample_multinomial",
-    "shuffle": "shuffle",
-}
+__all__ = sorted(_FORWARD)
 
 
 def __getattr__(name):

@@ -119,3 +119,46 @@ def test_custom_trains_under_module():
         m.backward([mx.nd.array(g / 32)])
         m.update()
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_custom_op_traced_without_callbacks_raises_clearly():
+    """On a backend with no host-callback support, tracing a CustomOp
+    must fail at trace time with an actionable MXNetError — not with the
+    backend's compile-time rejection."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import operator as op_mod
+
+    class Plus1(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        in_data[0].asnumpy() + 1.0)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], out_grad[0].asnumpy())
+
+    @mx.operator.register("plus1_nocb")
+    class Plus1Prop(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Plus1()
+
+    saved = op_mod._CALLBACK_SUPPORT
+    op_mod._CALLBACK_SUPPORT = False
+    try:
+        # eager fallback still works
+        out = mx.nd.Custom(mx.nd.ones((2, 2)), op_type="plus1_nocb")
+        assert float(out.asnumpy().sum()) == 8.0
+        # traced use raises the actionable error
+        import jax.numpy as jnp
+        with pytest.raises(mx.MXNetError, match="host callbacks"):
+            jax.jit(lambda x: mx.nd.Custom(
+                mx.nd.from_jax(x), op_type="plus1_nocb")._data)(
+                    jnp.ones((2, 2)))
+    finally:
+        op_mod._CALLBACK_SUPPORT = saved
