@@ -590,10 +590,24 @@ def main():
         jobs.append(lambda: bench_input_pipeline())
     details = []
     for job in jobs:
-        try:
-            details.append(job())
-        except Exception as e:  # keep the headline alive if one config OOMs
-            details.append({"error": repr(e)})
+        # jobs are idempotent; one retry rides out transient tunnel/
+        # compile-service hiccups so the official artifact stays complete
+        # (deterministic failures like OOM are NOT retried)
+        result = None
+        for attempt in (0, 1):
+            try:
+                result = job()
+                break
+            except Exception as e:
+                result = {"error": repr(e), "attempt": attempt}
+                print("# job failed (attempt %d): %r" % (attempt, e),
+                      file=sys.stderr)
+                deterministic = any(s in repr(e) for s in (
+                    "RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+                    "INVALID_ARGUMENT"))
+                if deterministic:
+                    break
+        details.append(result)
         print("# %s" % json.dumps(details[-1]), file=sys.stderr)
 
     headline = None

@@ -238,10 +238,13 @@ class _CustomRunner:
                 # _host_vjp hook instead of a traced callback
                 host = host_forward(*[onp.asarray(a) for a in ins])
                 return tuple(jax.device_put(h) for h in host)
-            if traced and not _callbacks_supported():
-                # fail at TRACE time with an actionable message rather
-                # than letting the backend reject the compiled program
-                # (the probe runs on concrete args, safe mid-trace)
+            if traced and _in_staging_trace(ins) \
+                    and not _callbacks_supported():
+                # a jit/hybridize STAGING trace would embed the callback
+                # in a compiled program this backend must reject — fail
+                # at trace time with an actionable message instead.
+                # (Eager grad/vmap tracers fall through: pure_callback's
+                # impl rule runs the host call directly and works.)
                 raise MXNetError(
                     "CustomOp %r reached a jit trace, but this backend "
                     "does not support host callbacks inside compiled "
@@ -284,6 +287,16 @@ def _runner_for(op_type, attrs, arrays, is_train):
                                    in_shapes, in_dtypes, is_train)
             _RUNNER_CACHE[key] = runner
     return runner
+
+
+def _in_staging_trace(ins) -> bool:
+    """True when any input is a jaxpr-staging tracer (jit/hybridize),
+    as opposed to an eager-transform tracer (grad/vmap outside jit)."""
+    try:
+        from jax._src.interpreters.partial_eval import DynamicJaxprTracer
+    except ImportError:  # private path moved: be conservative (no raise)
+        return False
+    return any(isinstance(a, DynamicJaxprTracer) for a in ins)
 
 
 _CALLBACK_SUPPORT = None
