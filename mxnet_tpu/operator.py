@@ -296,7 +296,28 @@ def _in_staging_trace(ins) -> bool:
         from jax._src.interpreters.partial_eval import DynamicJaxprTracer
     except ImportError:  # private path moved: be conservative (no raise)
         return False
-    return any(isinstance(a, DynamicJaxprTracer) for a in ins)
+    import jax
+
+    def staged(a):
+        # unwrap transform tracers (JVP/Batch/…) layered on top of the
+        # staging tracer by jit(grad(...)) / jit(vmap(...))
+        seen = 0
+        while isinstance(a, jax.core.Tracer) and seen < 16:
+            if isinstance(a, DynamicJaxprTracer):
+                return True
+            nxt = None
+            for attr in ("primal", "val"):
+                inner = getattr(a, attr, None)
+                if isinstance(inner, jax.core.Tracer):
+                    nxt = inner
+                    break
+            if nxt is None:
+                return False
+            a = nxt
+            seen += 1
+        return isinstance(a, DynamicJaxprTracer)
+
+    return any(staged(a) for a in ins)
 
 
 _CALLBACK_SUPPORT = None
@@ -310,12 +331,24 @@ def _callbacks_supported() -> bool:
     if _CALLBACK_SUPPORT is None:
         import jax
         import jax.numpy as jnp
+        import contextlib
+        # the first probe may fire while a user jit is being traced (a
+        # hybridized block's first op is the custom op) — escape the
+        # ambient trace or the probe jit is staged into it and float()
+        # raises ConcretizationTypeError, mis-caching "no callbacks"
+        eval_context = getattr(jax.core, "eval_context", None)
+        if eval_context is None:
+            try:
+                from jax._src.core import eval_context
+            except ImportError:
+                eval_context = contextlib.nullcontext
         try:
-            out = jax.jit(lambda x: jax.pure_callback(
-                lambda a: onp.asarray(a) + 1,
-                jax.ShapeDtypeStruct((), onp.float32), x))(
-                    jnp.zeros((), jnp.float32))
-            _CALLBACK_SUPPORT = float(out) == 1.0
+            with eval_context():
+                out = jax.jit(lambda x: jax.pure_callback(
+                    lambda a: onp.asarray(a) + 1,
+                    jax.ShapeDtypeStruct((), onp.float32), x))(
+                        jnp.zeros((), onp.float32))
+                _CALLBACK_SUPPORT = float(out) == 1.0
         except Exception:
             _CALLBACK_SUPPORT = False
     return _CALLBACK_SUPPORT

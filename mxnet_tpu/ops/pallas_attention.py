@@ -141,7 +141,7 @@ def _pad_qkv(q, k, v, block_q, block_k):
 
 
 def _expand_mask_operands(kv_lens, q_segments, kv_segments, B, H, Tqp, Tkp,
-                          transposed=False):
+                          true_tk=None, transposed=False):
     """Broadcast per-batch mask operands over heads into the kernels'
     (B*H, …) layouts: lens (BH, 1) int32, and segment ids shaped so they
     broadcast against the score block each kernel works on — forward
@@ -151,8 +151,13 @@ def _expand_mask_operands(kv_lens, q_segments, kv_segments, B, H, Tqp, Tkp,
     distinct sentinels (-1 / -2) so they never match anything."""
     lens = qs = ks = None
     if kv_lens is not None:
-        lens = jnp.broadcast_to(
-            kv_lens.astype(jnp.int32)[:, None], (B, H)).reshape(B * H, 1)
+        lens = kv_lens.astype(jnp.int32)
+        if true_tk is not None:
+            # clamp to the true (unpadded) K length: the kernels' length
+            # mask REPLACES the padded-tail mask, so an out-of-range
+            # kv_lens would let zero-padded key rows attend
+            lens = jnp.minimum(lens, true_tk)
+        lens = jnp.broadcast_to(lens[:, None], (B, H)).reshape(B * H, 1)
     if q_segments is not None:
         Tq = q_segments.shape[1]
         qs = jnp.pad(q_segments.astype(jnp.int32), ((0, 0), (0, Tqp - Tq)),
@@ -196,7 +201,7 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
     n_q = Tqp // block_q
     n_k = Tkp // block_k
     lens, qs, ks = _expand_mask_operands(kv_lens, q_segments, kv_segments,
-                                         B, H, Tqp, Tkp)
+                                         B, H, Tqp, Tkp, true_tk=Tk)
 
     extra, extra_specs = [], []
     if lens is not None:
@@ -415,7 +420,8 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
     # mask operands, bwd orientation: q segments as lane rows, kv segments
     # as sublane columns (scores are transposed in the backward kernels)
     lens, qs_row, ks_col = _expand_mask_operands(
-        kv_lens, q_segments, kv_segments, B, H, Tqp, Tkp, transposed=True)
+        kv_lens, q_segments, kv_segments, B, H, Tqp, Tkp, true_tk=Tk,
+        transposed=True)
 
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_k=Tk, has_lens=lens is not None,

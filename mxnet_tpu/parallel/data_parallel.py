@@ -48,7 +48,8 @@ def _resolve_mirror(mirror):
     FLOPs for HBM activation traffic.  ``"full"`` (env value 2) saves
     nothing but the step inputs — maximum memory saving.
     """
-    if mirror is None:
+    from_env = mirror is None
+    if from_env:
         import os
         mirror = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "")
     if mirror in (False, None, "", "0", 0):
@@ -57,6 +58,13 @@ def _resolve_mirror(mirror):
         return "mirror"
     if mirror in (2, "2", "full"):
         return "full"
+    if from_env:
+        # env-var typos degrade to off (matching the reference's lenient
+        # boolean env parsing) — only the explicit mirror= arg hard-fails
+        import warnings
+        warnings.warn("ignoring unrecognized MXNET_BACKWARD_DO_MIRROR=%r "
+                      "(expected 0/1/2)" % (mirror,))
+        return None
     raise ValueError("mirror must be one of None/'mirror'/'full', got %r"
                      % (mirror,))
 

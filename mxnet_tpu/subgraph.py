@@ -140,6 +140,7 @@ class ConvBNFoldProperty(SubgraphProperty):
     def apply(self, sym):
         from .symbol.symbol import Symbol, _SymNode
 
+        self.folds = []   # re-applying one property instance starts fresh
         nodes = sym._topo()
         consumers: Dict[tuple, int] = {}
         for n in nodes:

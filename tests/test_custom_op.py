@@ -160,5 +160,32 @@ def test_custom_op_traced_without_callbacks_raises_clearly():
             jax.jit(lambda x: mx.nd.Custom(
                 mx.nd.from_jax(x), op_type="plus1_nocb")._data)(
                     jnp.ones((2, 2)))
+        # nested transform tracers (jit of grad) must be detected too —
+        # a JVPTracer wrapping the staging tracer used to slip past
+        with pytest.raises(mx.MXNetError, match="host callbacks"):
+            jax.jit(jax.grad(lambda x: mx.nd.Custom(
+                mx.nd.from_jax(x), op_type="plus1_nocb")._data.sum()))(
+                    jnp.ones((2, 2)))
+    finally:
+        op_mod._CALLBACK_SUPPORT = saved
+
+
+def test_callback_probe_inside_active_trace():
+    """The support probe must escape the ambient trace: when the first
+    CustomOp use in a process is under jit, the probe fires mid-trace and
+    used to stage its own jit into the outer jaxpr, mis-caching False."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu.operator as op_mod
+
+    saved = op_mod._CALLBACK_SUPPORT
+    op_mod._CALLBACK_SUPPORT = None    # simulate fresh process
+    try:
+        out = jax.jit(lambda x: mx.nd.Custom(
+            mx.nd.from_jax(x), op_type="numpy_softmax")._data)(
+                jnp.ones((2, 3)))
+        onp.testing.assert_allclose(onp.asarray(out),
+                                    onp.full((2, 3), 1.0 / 3), rtol=1e-6)
+        assert op_mod._CALLBACK_SUPPORT is True
     finally:
         op_mod._CALLBACK_SUPPORT = saved

@@ -95,6 +95,19 @@ def test_pallas_kv_lens_matches_dense(lens):
     assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
 
 
+def test_pallas_kv_lens_beyond_tk_clamps_to_seq_len():
+    # out-of-range kv_lens (> Tk) must behave exactly like lens == Tk:
+    # the length mask replaces the padded-tail mask, so without clamping
+    # the zero-padded key rows would enter the online softmax
+    shape = (2, 2, 200, 64)       # Tk=200 pads to 256 inside the kernel
+    q, k, v = (_rand(shape, 45 + i) for i in range(3))
+    out = P.pallas_flash_attention(
+        q, k, v, interpret=True, block_q=128, block_k=128,
+        kv_lens=jnp.asarray([500, 200], jnp.int32))
+    ref = _dense_masked(q, k, v, kv_lens=jnp.asarray([200, 200], jnp.int32))
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
 def test_pallas_kv_lens_bwd_matches_dense_vjp():
     shape = (2, 2, 256, 64)
     q, k, v = (_rand(shape, 50 + i) for i in range(3))
