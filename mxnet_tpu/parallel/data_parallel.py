@@ -141,29 +141,71 @@ class DataParallelStep:
 
     # ------------------------------------------------------------------
     def __call__(self, data, label):
+        return self._dispatch(data, label, scan=False)
+
+    def scan_steps(self, data, label):
+        """Run ``k`` consecutive optimizer steps in ONE compiled program.
+
+        ``data``/``label`` carry a leading steps dimension ``(k, batch,
+        …)``; the program is a ``lax.scan`` over that dimension with the
+        parameters, optimizer state, step counter and RNG key as donated
+        carries.  Returns the per-step losses as an NDArray of shape
+        ``(k,)``.
+
+        This is the TPU-idiomatic inner training loop (the reference's
+        per-epoch batch loop, ``Module.fit`` / model.py:150-160, driven
+        by the engine's async queue): one dispatch per ``k`` steps
+        amortises the host round-trip, which on a tunneled dispatch path
+        costs several ms per call.  The learning-rate schedule is
+        sampled once per window (schedules move per-epoch, not per-step;
+        the step counter still advances per step inside the program).
+        """
+        return self._dispatch(data, label, scan=True)
+
+    def _dispatch(self, data, label, scan):
+        """Shared prologue/epilogue for the per-call and scan paths:
+        batch placement, compile-cache lookup, lr/step/RNG refresh, and
+        the parameter/opt-state writeback."""
         from . import shard_batch
 
         def prep(x):
             if x is None:
                 return None
+            val = x._data if isinstance(x, NDArray) else jnp.asarray(x)
             if self._mesh is not None:
-                x = shard_batch(x, self._mesh)
-            return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+                if scan:
+                    # leading dim is the step axis; the batch (dim 1) is
+                    # the one sharded over dp
+                    import jax.sharding as jsh
+                    spec = jsh.PartitionSpec(None, "dp",
+                                             *([None] * (val.ndim - 2)))
+                    val = jax.device_put(
+                        val, jsh.NamedSharding(self._mesh, spec))
+                else:
+                    val = shard_batch(val, self._mesh)
+            return val
 
         # data may be a tuple of forward inputs (None entries allowed),
         # e.g. (tokens, token_types, mask, valid_length) for BERT
         dval = (tuple(prep(d) for d in data) if isinstance(data, (tuple, list))
                 else prep(data))
         lval = prep(label)
+        if scan:
+            first = (next(d for d in dval if d is not None)
+                     if isinstance(dval, tuple) else dval)
+            lead = first.shape[0]
+        else:
+            lead = 1
         sig = lambda v: (None if v is None
                          else (tuple(v.shape), str(v.dtype)))
-        key = (tuple(sig(d) for d in dval) if isinstance(dval, tuple)
+        key = ("scan" if scan else "call",
+               tuple(sig(d) for d in dval) if isinstance(dval, tuple)
                else sig(dval), sig(lval))
         jfn = self._cache.get(key)
         if jfn is None:
-            jfn = self._build()
+            jfn = self._build(scan=scan)
             self._cache[key] = jfn
-        self._t += 1
+        self._t += lead
         # advance the optimizer's clock and read the *current* scheduled lr
         # per slot — passed traced so warmup/decay advance inside the cached
         # compiled step (the reference re-reads the schedule per update too)
@@ -173,7 +215,9 @@ class DataParallelStep:
             self._lrs_dev = jnp.asarray(lr_vals, jnp.float32)
             self._lrs_key = lr_vals
         if self._t_dev is None:
-            self._t_dev = jnp.asarray(self._t, jnp.int32)
+            # the FIRST update must run with t=1 (Adam-family bias
+            # correction divides by 1-beta**t, which is 0 at t=0)
+            self._t_dev = jnp.asarray(self._t - lead + 1, jnp.int32)
         if self._rng_dev is None or self._rng_epoch != _random.seed_epoch():
             # (re-)draw from the global stream — a fresh mx.random.seed()
             # must restart this step's dropout trajectory too
@@ -190,7 +234,7 @@ class DataParallelStep:
         return _wrap(loss)
 
     # ------------------------------------------------------------------
-    def _build(self):
+    def _build(self, scan=False):
         net, loss_fn, optimizer = self._net, self._loss, self._opt
         params = self._params
         trainable = self._trainable
@@ -267,4 +311,19 @@ class DataParallelStep:
             return new_pvals, new_states, t + 1, next_key, loss_val
 
         donate = (0, 1, 2, 4) if self._donate else ()
-        return jax.jit(step_fn, donate_argnums=donate)
+        if not scan:
+            return jax.jit(step_fn, donate_argnums=donate)
+
+        from jax import lax
+
+        def scan_fn(pvals, opt_states, t, lrs, rng, dseq, lseq):
+            def body(carry, xs):
+                pv, st, tt, key = carry
+                d, l = xs
+                npv, nst, tt, key, loss = step_fn(pv, st, tt, lrs, key, d, l)
+                return (npv, nst, tt, key), loss
+            (pvals, opt_states, t, rng), losses = lax.scan(
+                body, (pvals, opt_states, t, rng), (dseq, lseq))
+            return pvals, opt_states, t, rng, losses
+
+        return jax.jit(scan_fn, donate_argnums=donate)

@@ -1,0 +1,90 @@
+"""Bench harness protocol units (CPU-safe): the median-of-k timer, the
+physical-plausibility gates, and the local history comparison.
+
+Reference counterpart: the measurement discipline of
+``benchmark/python/`` + ``example/image-classification/benchmark_score.py``
+(median over multiple timed repetitions)."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+
+def _load_bench():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load_bench()
+
+
+def test_time_calls_takes_median_and_reports_reps(bench):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        return calls["n"]
+
+    med, out, detail = bench._time_calls(fn, lambda x: None, warmup=1,
+                                         iters=2, reps=3)
+    # 1 warmup + 3 reps x 2 iters, plus at most 2 extra reps if the
+    # (sub-microsecond, jittery) spread tripped the redo threshold
+    assert calls["n"] in (7, 9, 11)
+    assert 3 <= len(detail["reps_ms"]) <= 5
+    assert detail["spread"] is not None
+
+
+def test_time_calls_extra_reps_on_high_spread(bench, monkeypatch):
+    # one artificially slow rep (>25% spread) must trigger extra reps
+    seq = iter([0.0, 1.0,          # rep1: 1s/call x2... (t0, t1)
+                0.0, 0.1,          # rep2
+                0.0, 0.1,          # rep3
+                0.0, 0.1,          # extra rep 4
+                0.0, 0.1])         # extra rep 5
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: next(seq))
+    med, _, detail = bench._time_calls(lambda: None, lambda x: None,
+                                       warmup=0, iters=1, reps=3)
+    assert len(detail["reps_ms"]) == 5
+    assert med == pytest.approx(0.1)
+
+
+def test_sanity_gate_flags_bf16_slower_than_fp32(bench):
+    details = [
+        {"bench": "inference", "model": "resnet50_v1", "dtype": "float32",
+         "img_per_sec": 6000.0},
+        {"bench": "inference", "model": "resnet50_v1", "dtype": "bfloat16",
+         "img_per_sec": 5000.0},
+    ]
+    flags = bench._sanity_gates(details)
+    assert any("implausible" in f for f in flags)
+    details[1]["img_per_sec"] = 9000.0
+    assert not any("implausible" in f for f in bench._sanity_gates(details))
+
+
+def test_sanity_gate_flags_regression_vs_history(bench, tmp_path,
+                                                 monkeypatch):
+    hist = tmp_path / "BENCH_HISTORY.json"
+    monkeypatch.setattr(bench, "_history_path", lambda: str(hist))
+    run1 = [{"bench": "train", "model": "resnet50_v1", "batch_size": 128,
+             "dtype": "bfloat16", "mirror": None, "img_per_sec": 2500.0}]
+    bench._update_history(run1)
+    run2 = [dict(run1[0], img_per_sec=1500.0)]
+    flags = bench._sanity_gates(run2)
+    assert any("regression" in f for f in flags)
+    run3 = [dict(run1[0], img_per_sec=2400.0)]
+    assert not bench._sanity_gates(run3)
+
+
+def test_history_keeps_bounded_entries(bench, tmp_path, monkeypatch):
+    hist = tmp_path / "BENCH_HISTORY.json"
+    monkeypatch.setattr(bench, "_history_path", lambda: str(hist))
+    for i in range(15):
+        bench._update_history([{"bench": "train", "img_per_sec": float(i)}])
+    assert len(bench._load_history()) == 12

@@ -89,6 +89,99 @@ def test_data_parallel_matches_single_device(mesh8):
             pa.data().asnumpy(), pb.data().asnumpy(), rtol=2e-4, atol=2e-5)
 
 
+def test_scan_steps_matches_sequential_calls(mesh8):
+    """k steps through scan_steps (one compiled lax.scan program) must
+    follow the exact same trajectory as k per-call steps."""
+    onp.random.seed(3)
+    xs = onp.random.randn(4, 16, 8).astype("float32")
+    ys = onp.random.randint(0, 4, (4, 16)).astype("float32")
+
+    def build():
+        onp.random.seed(7)
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+        net.initialize()
+        net(mx.nd.array(xs[0]))
+        L = gloss.SoftmaxCrossEntropyLoss()
+        return net, parallel.DataParallelStep(
+            net, lambda o, l: L(o, l),
+            mx.optimizer.SGD(learning_rate=0.2, momentum=0.9), mesh=mesh8)
+
+    net_a, step_a = build()
+    losses_scan = step_a.scan_steps(mx.nd.array(xs), mx.nd.array(ys))
+    assert losses_scan.shape == (4,)
+
+    net_b, step_b = build()
+    losses_seq = [float(step_b(mx.nd.array(x), mx.nd.array(y)).asscalar())
+                  for x, y in zip(xs, ys)]
+
+    onp.testing.assert_allclose(losses_scan.asnumpy(), losses_seq,
+                                rtol=1e-5, atol=1e-6)
+    for (ka, pa), (kb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        onp.testing.assert_allclose(
+            pa.data().asnumpy(), pb.data().asnumpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_scan_steps_first_call_adam_is_finite(mesh8):
+    """A fresh step whose FIRST dispatch is scan_steps must seed the
+    device step counter at 1: Adam's bias correction divides by
+    1-beta**t, which is 0/0 at t=0 (regression: scan seeded t=0)."""
+    x = onp.random.RandomState(2).randn(3, 8, 6).astype("float32")
+    y = onp.random.RandomState(3).randint(0, 4, (3, 8)).astype("float32")
+    L = gloss.SoftmaxCrossEntropyLoss()
+
+    def build():
+        onp.random.seed(21)
+        mx.random.seed(21)
+        n = nn.HybridSequential()
+        n.add(nn.Dense(4))
+        n.initialize()
+        n(mx.nd.array(x[0]))
+        return n, parallel.DataParallelStep(
+            n, lambda o, l: L(o, l), mx.optimizer.Adam(learning_rate=1e-2),
+            mesh=mesh8)
+
+    # identical twin trained per-call: Adam's t sequence must match, so
+    # the trajectories must match exactly
+    net, step = build()
+    net_b, step_b = build()
+
+    losses = step.scan_steps(mx.nd.array(x), mx.nd.array(y))
+    assert onp.isfinite(losses.asnumpy()).all()
+    for _, p in net.collect_params().items():
+        assert onp.isfinite(p.data().asnumpy()).all()
+
+    l_seq = [float(step_b(mx.nd.array(xi), mx.nd.array(yi)).asscalar())
+             for xi, yi in zip(x, y)]
+    onp.testing.assert_allclose(losses.asnumpy(), l_seq, rtol=1e-5,
+                                atol=1e-6)
+    for (ka, pa), (kb, pb) in zip(sorted(net.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        onp.testing.assert_allclose(pa.data().asnumpy(),
+                                    pb.data().asnumpy(),
+                                    rtol=2e-5, atol=2e-6)
+
+
+def test_scan_steps_then_call_interleave(mesh8):
+    """scan_steps leaves the step counter/opt state usable by __call__."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = onp.random.RandomState(0).randn(2, 8, 6).astype("float32")
+    y = onp.random.RandomState(1).randint(0, 4, (2, 8)).astype("float32")
+    net(mx.nd.array(x[0]))
+    L = gloss.SoftmaxCrossEntropyLoss()
+    step = parallel.DataParallelStep(
+        net, lambda o, l: L(o, l), mx.optimizer.SGD(learning_rate=0.1),
+        mesh=mesh8)
+    step.scan_steps(mx.nd.array(x), mx.nd.array(y))
+    out = step(mx.nd.array(x[0]), mx.nd.array(y[0]))
+    assert onp.isfinite(float(out.asscalar()))
+    assert step._t == 3
+
+
 def test_psum_in_shard_map(mesh8):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P

@@ -24,8 +24,9 @@ def main():
         try:
             step, data, label = bench._build_train_step(
                 "resnet50_v1", int(bs), dt, mirror=mode)
-            step_s, loss = bench._time_calls(lambda: step(data, label),
-                                             bench._sync, iters=args.iters)
+            step_s, loss, _ = bench._time_calls(lambda: step(data, label),
+                                                bench._sync,
+                                                iters=args.iters)
             out = {"bs": int(bs), "dtype": dt, "mirror": mode,
                    "step_ms": round(step_s * 1000, 2),
                    "img_s": round(int(bs) / step_s, 1),
