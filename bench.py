@@ -510,14 +510,19 @@ def bench_ssd(batch_size=32, image_size=128, iters=8):
 
 
 def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
-                    inner=10, dtype="bfloat16"):
-    """Flash-attention (Pallas TPU kernel) vs dense jnp attention, fwd+bwd.
-    Proxy for BASELINE.json config 5 (BERT pretraining attention cost).
+                    inner=10, dtype="bfloat16", check_error=True):
+    """Flash-attention (Pallas TPU kernel) vs dense jnp attention, FULL
+    fwd+bwd (gradients w.r.t. q, k AND v — round-4's dq-only grad let
+    XLA dead-code-eliminate the dk/dv kernel, overstating throughput
+    ~2x).  Proxy for BASELINE.json config 5 (BERT pretraining attention).
 
     The host→chip dispatch path here costs ~3-6 ms per call, so the
     measured region runs ``inner`` chained fwd+bwd iterations inside ONE
     jitted program (lax.fori_loop with a data dependence) — kernel time,
-    not dispatch time.
+    not dispatch time.  ``check_error`` also computes the ON-DEVICE max
+    abs error of the flash fwd output and all three gradients against
+    the dense path (the reference's `check_consistency` discipline,
+    python/mxnet/test_utils.py:1283, run on the real chip).
     """
     import numpy as onp
     import jax
@@ -537,19 +542,25 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
 
     def mk_loop(fn):
         grad = jax.grad(lambda q, k, v:
-                        jnp.sum(fn(q, k, v).astype(jnp.float32)))
+                        jnp.sum(fn(q, k, v).astype(jnp.float32)),
+                        argnums=(0, 1, 2))
 
         @jax.jit
         def loop(q, k, v):
             def body(_, q):
-                dq = grad(q, k, v)
-                return q + 0.0 * dq.astype(q.dtype)  # data dep, no drift
+                dq, dk, dv = grad(q, k, v)
+                # data dependence on ALL THREE grads, no drift
+                return q + 0.0 * (dq + dk + dv).astype(q.dtype)
             return lax.fori_loop(0, inner, body, q)
         return loop
 
-    flops = 4 * batch * heads * seqlen * seqlen * head_dim * 3  # fwd+bwd
+    # true executed FLOPs per path: flash runs 9 dots (fwd 2; dq kernel
+    # recomputes p, dp then dq; dkv kernel recomputes p, dp then dk, dv),
+    # dense runs 6 (fwd 2; bwd dp, dv, dq, dk — softmax residuals saved)
+    dot = 2 * batch * heads * seqlen * seqlen * head_dim
+    n_dots = {"flash": 9, "dense": 6}
     out = {"bench": "attention", "shape": list(shape), "dtype": dtype,
-           "inner_iters": inner}
+           "inner_iters": inner, "grads": "q,k,v"}
     for name, fn in (("flash", flash_attention), ("dense", dense)):
         try:
             loop = mk_loop(fn)
@@ -559,11 +570,32 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
                 warmup=1, iters=iters)
             dt /= inner
             out[name + "_ms"] = round(dt * 1000, 3)
-            out[name + "_tflops"] = round(flops / dt / 1e12, 1)
+            out[name + "_tflops"] = round(dot * n_dots[name] / dt / 1e12, 1)
         except Exception as e:
             out[name + "_error"] = repr(e)
     if "flash_ms" in out and "dense_ms" in out:
         out["flash_speedup"] = round(out["dense_ms"] / out["flash_ms"], 2)
+
+    if check_error and "flash_ms" in out and "dense_ms" in out:
+        # on-chip cross-check of the custom kernels vs the dense oracle
+        @jax.jit
+        def errs(q, k, v):
+            g = jnp.ones(shape, dtype)
+            fo, f_vjp = jax.vjp(flash_attention, q, k, v)
+            do_, d_vjp = jax.vjp(dense, q, k, v)
+            fg = f_vjp(g)[:3]
+            dg = d_vjp(g)
+            def mx(a, b):
+                return jnp.max(jnp.abs(a.astype(jnp.float32)
+                                       - b.astype(jnp.float32)))
+            return (mx(fo, do_),) + tuple(mx(a, b) for a, b in zip(fg, dg))
+        e_out, e_dq, e_dk, e_dv = (float(x) for x in errs(q, k, v))
+        out["max_err"] = {"out": round(e_out, 5), "dq": round(e_dq, 5),
+                          "dk": round(e_dk, 5), "dv": round(e_dv, 5)}
+        # bf16 inputs: online-softmax vs dense disagreement is rounding-
+        # level; anything past this threshold means a broken kernel
+        tol = 0.06 if dtype in ("bfloat16", "float16") else 1e-3
+        out["max_err_ok"] = all(e < tol for e in (e_out, e_dq, e_dk, e_dv))
     return out
 
 
@@ -624,7 +656,11 @@ def main():
                 args.model, 128, dt, iters=args.iters))
         jobs.append(lambda: bench_lstm_lm(iters=args.iters))
         jobs.append(lambda: bench_lstm_lm(dtype="bfloat16", iters=args.iters))
+        jobs.append(lambda: bench_attention(seqlen=512,
+                                            iters=max(1, args.iters // 4)))
         jobs.append(lambda: bench_attention(iters=max(1, args.iters // 4)))
+        jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
+                                            iters=max(1, args.iters // 4)))
         jobs.append(lambda: bench_bert(iters=args.iters))
         jobs.append(lambda: bench_ssd(iters=max(4, args.iters // 3)))
         jobs.append(lambda: bench_input_pipeline())
@@ -657,7 +693,14 @@ def main():
         jobs.append(lambda: bench_lstm_lm(dtype="bfloat16",
                                           iters=max(8, it // 2)))
         # 5) BERT MLM train (padded, flash-masked) + attention microbench
+        # at BERT's production shape (S=512), the headline S=2048, and a
+        # long-context point (S=4096; smaller batch so the dense oracle
+        # fits for the on-chip error check)
+        jobs.append(lambda: bench_attention(seqlen=512,
+                                            iters=max(2, it // 4)))
         jobs.append(lambda: bench_attention(iters=max(2, it // 4)))
+        jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
+                                            iters=max(2, it // 4)))
         jobs.append(lambda: bench_bert(iters=max(6, it // 2)))
         # detection train step (device-side MultiBoxTarget, no callbacks)
         jobs.append(lambda: bench_ssd(iters=max(4, it // 3)))
@@ -754,6 +797,12 @@ def _sanity_gates(details):
                      "fp32 (%.0f img/s) — rerun, this is measurement noise"
                      % (inf["bfloat16"]["img_per_sec"],
                         inf["float32"]["img_per_sec"]))
+    for d in details:
+        if isinstance(d, dict) and d.get("max_err_ok") is False:
+            flags.append("KERNEL ERROR: %s %s on-chip max_err %s exceeds "
+                         "tolerance vs the dense oracle"
+                         % (d.get("bench"), d.get("shape"),
+                            d.get("max_err")))
     hist = _load_history()
     if hist:
         prev = {}

@@ -43,8 +43,30 @@ _LANES = 128
 # forward
 # ---------------------------------------------------------------------------
 
+def _run_mask_specialized(pl, compute, run, qi, ki, block_q, block_k,
+                          causal, has_lens, has_seg, needs_tail):
+    """Shared mask-dispatch ladder for all three kernels.
+
+    ``compute(use_mask)`` runs the block; this picks the cheapest correct
+    specialization: no mask at all when nothing can mask the block, a
+    full-block/diagonal-straddle split for causal-only (blocks wholly
+    below the diagonal are fully visible), else the masked path guarded
+    by ``run`` (block-skip predicate)."""
+    masked = has_lens or has_seg or causal or needs_tail
+    if not masked:
+        compute(False)
+    elif causal and not (has_lens or has_seg or needs_tail):
+        full = (qi * block_q) >= (ki * block_k + block_k - 1)
+        pl.when(run & full)(lambda: compute(False))
+        pl.when(run & jnp.logical_not(full))(lambda: compute(True))
+    elif run is True:
+        compute(True)
+    else:
+        pl.when(run)(lambda: compute(True))
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
-                seq_k, n_k, has_lens, has_seg):
+                seq_k, seq_k_padded, n_k, has_lens, has_seg):
     import jax.experimental.pallas as pl
 
     rest = list(rest)
@@ -60,13 +82,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
     # blocks be full-dim or (8,128)-tiled); index by the grid's batch coord
     kvlen = lens_ref[bi, 0] if has_lens else None
 
+    # static fast path (see _run_mask_specialized): skip the iota/compare/
+    # select mask chain over the (block_q, block_k) score tile whenever
+    # nothing can actually mask this block
+    needs_tail = seq_k != seq_k_padded
+
     @pl.when(ki == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def _compute():
+    def _compute(use_mask):
         q = q_ref[0]                       # (block_q, d)
         k = k_ref[0]                       # (block_k, d)
         v = v_ref[0]
@@ -75,16 +102,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
 
         # mask: padded K tail, plus causal upper triangle, plus the
         # variable-length / segment masks when present
-        col = ki * block_k + lax.broadcasted_iota(jnp.int32,
-                                                  (block_q, block_k), 1)
-        mask = col < (kvlen if has_lens else seq_k)
-        if causal:
-            row = qi * block_q + lax.broadcasted_iota(jnp.int32,
-                                                      (block_q, block_k), 0)
-            mask = mask & (row >= col)
-        if has_seg:
-            mask = mask & (qseg_ref[0] == kseg_ref[0])  # (bq,1)==(1,bk)
-        s = jnp.where(mask, s, _NEG_INF)
+        if use_mask:
+            col = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+            mask = col < (kvlen if has_lens else seq_k)
+            if causal:
+                row = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                mask = mask & (row >= col)
+            if has_seg:
+                mask = mask & (qseg_ref[0] == kseg_ref[0])  # (bq,1)==(1,bk)
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[...][:, :1]         # (block_q, 1); lanes replicated
         l_prev = l_ref[...][:, :1]
@@ -94,7 +122,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
         # explicit zero on masked entries: in a fully-masked row m_new is
         # itself _NEG_INF, so exp(s - m_new) would be exp(0)=1 — the row
         # must instead stay empty (l==0 → out 0, lse pinned)
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        if use_mask:
+            p = jnp.where(mask, p, 0.0)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -109,10 +139,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
     if has_lens:
         # skip K blocks entirely past this batch row's valid length
         run = run & (ki * block_k < kvlen)
-    if run is True:
-        _compute()
-    else:
-        pl.when(run)(_compute)
+    _run_mask_specialized(pl, _compute, run, qi, ki, block_q, block_k,
+                          causal, has_lens, has_seg, needs_tail)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -218,8 +246,8 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=Tk, n_k=n_k, has_lens=lens is not None,
-        has_seg=qs is not None)
+        block_k=block_k, seq_k=Tk, seq_k_padded=Tkp, n_k=n_k,
+        has_lens=lens is not None, has_seg=qs is not None)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, n_q, n_k),
@@ -256,20 +284,21 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
 # ---------------------------------------------------------------------------
 
 def _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k, seq_k, causal,
-              kvlen=None, qseg_row=None, kseg_col=None):
+              kvlen=None, qseg_row=None, kseg_col=None, use_mask=True):
     """Recomputed transposed probability block pᵀ (block_k, block_q)."""
     sT = lax.dot_general(k, q, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32) * scale
-    kcol = ki * block_k + lax.broadcasted_iota(jnp.int32,
-                                               (block_k, block_q), 0)
-    mask = kcol < (seq_k if kvlen is None else kvlen)
-    if causal:
-        qrow = qi * block_q + lax.broadcasted_iota(jnp.int32,
-                                                   (block_k, block_q), 1)
-        mask = mask & (qrow >= kcol)
-    if qseg_row is not None:
-        mask = mask & (kseg_col == qseg_row)    # (bk,1)==(1,bq)
-    sT = jnp.where(mask, sT, _NEG_INF)
+    if use_mask:
+        kcol = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                   (block_k, block_q), 0)
+        mask = kcol < (seq_k if kvlen is None else kvlen)
+        if causal:
+            qrow = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                       (block_k, block_q), 1)
+            mask = mask & (qrow >= kcol)
+        if qseg_row is not None:
+            mask = mask & (kseg_col == qseg_row)    # (bk,1)==(1,bq)
+        sT = jnp.where(mask, sT, _NEG_INF)
     return jnp.exp(sT - lse_row)           # lse_row: (1, block_q)
 
 
@@ -282,7 +311,7 @@ def _bwd_unpack(rest, has_lens, has_seg):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
-               scale, causal, block_q, block_k, seq_k, n_k,
+               scale, causal, block_q, block_k, seq_k, seq_k_padded, n_k,
                has_lens, has_seg):
     import jax.experimental.pallas as pl
 
@@ -292,12 +321,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     kvlen = lens_ref[pl.program_id(0), 0] if has_lens else None
+    needs_tail = seq_k != seq_k_padded
 
     @pl.when(ki == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def _compute():
+    def _compute(use_mask):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -307,7 +337,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
                        seq_k, causal, kvlen=kvlen,
                        qseg_row=qseg_ref[0] if has_seg else None,
-                       kseg_col=kseg_ref[0] if has_seg else None)
+                       kseg_col=kseg_ref[0] if has_seg else None,
+                       use_mask=use_mask)
         dpT = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
         dsT = pT * (dpT - dlt_row) * scale      # (block_k, block_q)
@@ -320,10 +351,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         run = (qi * block_q + block_q - 1) >= (ki * block_k)
     if has_lens:
         run = run & (ki * block_k < kvlen)
-    if run is True:
-        _compute()
-    else:
-        pl.when(run)(_compute)
+    _run_mask_specialized(pl, _compute, run, qi, ki, block_q, block_k,
+                          causal, has_lens, has_seg, needs_tail)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -331,7 +360,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
-                scale, causal, block_q, block_k, seq_k, n_q,
+                scale, causal, block_q, block_k, seq_k, seq_k_padded, n_q,
                 has_lens, has_seg):
     import jax.experimental.pallas as pl
 
@@ -341,13 +370,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     kvlen = lens_ref[pl.program_id(0), 0] if has_lens else None
+    needs_tail = seq_k != seq_k_padded
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def _compute():
+    def _compute(use_mask):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -357,7 +387,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
                        seq_k, causal, kvlen=kvlen,
                        qseg_row=qseg_ref[0] if has_seg else None,
-                       kseg_col=kseg_ref[0] if has_seg else None)
+                       kseg_col=kseg_ref[0] if has_seg else None,
+                       use_mask=use_mask)
         dv_acc[...] += lax.dot_general(
             pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -374,10 +405,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
     if has_lens:
         # dk/dv of keys past the valid length are zero — skip the block
         run = run & (ki * block_k < kvlen)
-    if run is True:
-        _compute()
-    else:
-        pl.when(run)(_compute)
+    _run_mask_specialized(pl, _compute, run, qi, ki, block_q, block_k,
+                          causal, has_lens, has_seg, needs_tail)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -424,8 +453,8 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
         transposed=True)
 
     common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, seq_k=Tk, has_lens=lens is not None,
-                  has_seg=qs_row is not None)
+                  block_k=block_k, seq_k=Tk, seq_k_padded=Tkp,
+                  has_lens=lens is not None, has_seg=qs_row is not None)
 
     def extra_for(kv_idx, q_idx):
         # kv_idx/q_idx map grid coords -> (k-block index, q-block index)

@@ -68,6 +68,14 @@ def test_sanity_gate_flags_bf16_slower_than_fp32(bench):
     assert not any("implausible" in f for f in bench._sanity_gates(details))
 
 
+def test_sanity_gate_flags_kernel_error(bench):
+    details = [{"bench": "attention", "shape": [8, 16, 2048, 64],
+                "max_err": {"out": 0.5}, "max_err_ok": False}]
+    assert any("KERNEL ERROR" in f for f in bench._sanity_gates(details))
+    details[0]["max_err_ok"] = True
+    assert not bench._sanity_gates(details)
+
+
 def test_sanity_gate_flags_regression_vs_history(bench, tmp_path,
                                                  monkeypatch):
     hist = tmp_path / "BENCH_HISTORY.json"
