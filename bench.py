@@ -368,19 +368,35 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
             n += batch.data[0].shape[0]
     host_rate = n / (time.perf_counter() - t0)
 
-    # (b) host->device transfer for one batch (bf16 on the wire)
-    it = fresh_iter()
-    warm = next(iter(it))
-    host_batch = warm.data[0].asnumpy().astype(onp.float32)
+    # (b) steady-state wire leg: uint8 batches (4x smaller than f32),
+    # double-buffered async device_put, on-device normalize — one full
+    # epoch, syncing each delivered device batch
     import jax
-    import jax.numpy as jnp
-    h2d = jax.device_put(jnp.asarray(host_batch, jnp.bfloat16))
-    jax.block_until_ready(h2d)
-    t0 = time.perf_counter()
-    h2d = jax.device_put(jnp.asarray(host_batch, jnp.bfloat16))
-    float(onp.asarray(h2d[0, 0, 0, 0]))
-    h2d_s = time.perf_counter() - t0
-    h2d_rate = batch_size / h2d_s
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    def fresh_u8_iter():
+        return ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, image_size, image_size),
+            batch_size=batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, mean_r=123.68, mean_g=116.78, mean_b=103.94,
+            std_r=58.4, std_g=57.12, std_b=57.38, preprocess_threads=8,
+            u8_output=True)
+
+    feed = DevicePrefetchIter(fresh_u8_iter(), dtype="bfloat16")
+    n = 0
+    last = None
+    t0 = None
+    for batch in feed:
+        if t0 is None:  # exclude normalize-jit compile from the steady rate
+            _sync(batch.data[0])
+            t0 = time.perf_counter()
+            continue
+        n += batch.data[0].shape[0]
+        last = batch.data[0]
+    if last is not None:
+        _sync(last)     # one sync: transfers pipeline, like a real feed
+    wire_rate = n / (time.perf_counter() - t0) if n else 0.0
+    feed.close()
 
     # (c) the train step itself (synthetic on-device data)
     step, data, label = _build_train_step(train_model, batch_size,
@@ -390,19 +406,45 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
                                warmup=3, iters=max(4, iters))
     step_rate = batch_size / step_s
 
+    # (d) OVERLAPPED end-to-end: .rec -> per-image-parallel decode -> u8
+    # wire (double-buffered) -> on-device normalize -> train step, one
+    # epoch, one sync at the end — every leg runs concurrently, so this
+    # is the sustained trainable rate, not a one-shot probe
+    feed = DevicePrefetchIter(fresh_u8_iter(), dtype="bfloat16")
+    loss = None
+    n = 0
+    t0 = None
+    for batch in feed:
+        if t0 is None:  # first batch pays the normalize-jit compile:
+            _sync(batch.data[0])        # exclude it, as in leg (b)
+            t0 = time.perf_counter()
+        loss = step(batch.data[0], batch.label[0])
+        n += batch.data[0].shape[0]
+    if loss is not None:
+        _sync(loss)
+    e2e_rate = n / (time.perf_counter() - t0) if t0 else 0.0
+    feed.close()
+
     shutil.rmtree(d, ignore_errors=True)
-    # A pipelined trainer runs all three legs concurrently, so sustained
-    # throughput is the slowest leg.  NOTE: in this dev environment the
-    # device sits behind a network tunnel, so the H2D leg measures tunnel
-    # bandwidth; on a real TPU host it is a local PCIe/DMA copy and the
-    # native decode pipeline is the leg that must keep up.
+    # Sustained throughput is the slowest overlapped leg.  NOTE: this dev
+    # environment has ONE host CPU core (decode is serial no matter the
+    # thread count) and the device sits behind a ~5 MB/s network tunnel
+    # (the wire leg measures tunnel bandwidth, not PCIe) — on a real TPU
+    # host both legs scale: decode ~linearly in cores (per-image work
+    # stealing), wire is local DMA.  The honest host-side roofline ships
+    # in the artifact: decode_cores and the per-core decode rate.
+    import os as _os
+    cores = _os.cpu_count() or 1
     return {"bench": "input_pipeline", "batch_size": batch_size,
             "n_images": n_images, "image_size": image_size,
+            "wire_format": "uint8+device_normalize",
+            "decode_cores": cores,
             "rec_to_host_img_s": round(host_rate, 1),
-            "host_to_device_img_s": round(h2d_rate, 1),
+            "rec_to_host_img_s_per_core": round(host_rate / cores, 1),
+            "device_feed_img_s": round(wire_rate, 1),
             "train_step_img_s": round(step_rate, 1),
-            "bottleneck_img_s": round(min(host_rate, h2d_rate,
-                                          step_rate), 1)}
+            "end_to_end_img_s": round(e2e_rate, 1),
+            "end_to_end_vs_train_step": round(e2e_rate / step_rate, 3)}
 
 
 def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,

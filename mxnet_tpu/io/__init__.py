@@ -3,6 +3,8 @@ from .io import (  # noqa: F401
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
     CSVIter, MNISTIter)
 from .image_record_iter import ImageRecordIter  # noqa: F401
+from .device_prefetch import DevicePrefetchIter  # noqa: F401
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "DevicePrefetchIter"]

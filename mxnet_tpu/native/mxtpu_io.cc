@@ -6,13 +6,19 @@
 //     OMP decode workers, prefetch, inline augmentation)
 //
 // Design: the .rec file is mmap'd (zero-copy record access); a pool of
-// worker threads each assembles WHOLE batches (JPEG decode via libjpeg,
-// bilinear resize, random/center crop, mirror, mean/std normalize, NCHW
-// float32) into recycled slot buffers; completed batches are delivered to
-// Python IN ORDER through a bounded queue.  The host→device copy then
-// happens on the Python side (jax.device_put double-buffering), so decode
-// for batch N+1 overlaps both compute and transfer of batch N — same
-// overlap structure the reference gets from its prefetcher + OMP decoders.
+// worker threads pulls INDIVIDUAL images off a work queue spanning the
+// in-flight batch slots (JPEG decode via libjpeg, bilinear resize,
+// random/center crop, mirror, then either mean/std-normalized NCHW
+// float32 or raw NCHW uint8 for on-device normalization); completed
+// batches are delivered to Python IN ORDER through a bounded queue.
+// Per-image (not per-batch) work units mean all N threads decode even
+// when only one batch slot is free — the reference's OMP inner loop
+// (iter_image_recordio_2.cc ParseChunk) has the same granularity.  The
+// host→device copy happens on the Python side (jax.device_put
+// double-buffering), so decode for batch N+1 overlaps both compute and
+// transfer of batch N.  Augmentation RNG is keyed on (seed, epoch,
+// record position) so results are bit-identical regardless of thread
+// count or scheduling.
 //
 // Exposed as a C ABI consumed by ctypes (no pybind11 in this image).
 
@@ -204,15 +210,22 @@ struct AugParams {
   int resize_short;   // 0 = off
   int rand_crop;      // else center crop
   int rand_mirror;    // 50% hflip
+  int u8_out;         // raw uint8 planes (device-side normalize)
   float mean[3], std[3];
 };
 
+// `outf` (normalized f32) or `outu` (raw u8) receives the NCHW planes,
+// per ap.u8_out.
 void process_record(const uint8_t* jpg, uint64_t len, const AugParams& ap,
-                    float* out, std::mt19937* rng, bool* ok) {
+                    float* outf, uint8_t* outu, std::mt19937* rng, bool* ok) {
   std::vector<uint8_t> img;
   int h = 0, w = 0;
   if (!jpeg_decode(jpg, len, &img, &h, &w)) {
-    std::fill(out, out + uint64_t(3) * ap.out_h * ap.out_w, 0.f);
+    const uint64_t n = uint64_t(3) * ap.out_h * ap.out_w;
+    if (ap.u8_out)
+      std::fill(outu, outu + n, uint8_t(0));
+    else
+      std::fill(outf, outf + n, 0.f);
     *ok = false;
     return;
   }
@@ -260,9 +273,15 @@ void process_record(const uint8_t* jpg, uint64_t len, const AugParams& ap,
       int sx = mirror ? (ap.out_w - 1 - x) : x;
       const uint8_t* p = row + uint64_t(sx) * 3;
       uint64_t o = uint64_t(y) * ap.out_w + x;
-      out[o] = (p[0] - ap.mean[0]) / ap.std[0];
-      out[plane + o] = (p[1] - ap.mean[1]) / ap.std[1];
-      out[2 * plane + o] = (p[2] - ap.mean[2]) / ap.std[2];
+      if (ap.u8_out) {
+        outu[o] = p[0];
+        outu[plane + o] = p[1];
+        outu[2 * plane + o] = p[2];
+      } else {
+        outf[o] = (p[0] - ap.mean[0]) / ap.std[0];
+        outf[plane + o] = (p[1] - ap.mean[1]) / ap.std[1];
+        outf[2 * plane + o] = (p[2] - ap.mean[2]) / ap.std[2];
+      }
     }
   }
 }
@@ -272,10 +291,20 @@ void process_record(const uint8_t* jpg, uint64_t len, const AugParams& ap,
 // ---------------------------------------------------------------------------
 
 struct Batch {
-  std::vector<float> data;    // batch * 3 * H * W
-  std::vector<float> labels;  // batch * label_width
-  int pad = 0;                // trailing wrapped records (last batch)
-  int errors = 0;             // undecodable records (zero-filled)
+  std::vector<float> data;      // batch * 3 * H * W (f32 mode)
+  std::vector<uint8_t> data_u8; // batch * 3 * H * W (u8 mode)
+  std::vector<float> labels;    // batch * label_width
+  int pad = 0;                  // trailing wrapped records (last batch)
+  int errors = 0;               // undecodable records (zero-filled)
+};
+
+// A batch slot currently being filled: workers pull image indices from
+// it one at a time (per-image work stealing).
+struct Active {
+  Batch* slot = nullptr;
+  int bidx = 0;
+  int img_next = 0;    // next image index to claim, guarded by mu
+  int remaining = 0;   // images not yet finished, guarded by mu
 };
 
 struct Pipeline {
@@ -291,11 +320,12 @@ struct Pipeline {
   std::mutex mu;
   std::condition_variable cv_work, cv_done;
   int n_batches = 0;
-  int next_produce = 0;              // guarded by mu
+  int next_produce = 0;              // next batch index to activate
   int next_deliver = 0;              // guarded by mu
+  std::deque<Active*> actives;       // slots being filled, guarded by mu
   std::map<int, Batch*> completed;   // guarded by mu
   std::deque<Batch*> free_slots;     // guarded by mu
-  int in_flight = 0;                 // claimed but not completed, guarded by mu
+  int busy = 0;                      // workers mid-image, guarded by mu
   bool paused = false;               // epoch transition in progress
   bool stopping = false;
   std::vector<std::thread> workers;
@@ -309,76 +339,112 @@ struct Pipeline {
     cv_work.notify_all();
     cv_done.notify_all();
     for (auto& t : workers) t.join();
+    for (auto* a : actives) delete a;
     rec_close(file);
   }
 };
 
+// Requires p->mu held: an image is claimable, or a new slot can start.
+bool work_available_locked(Pipeline* p) {
+  if (p->paused) return false;
+  for (auto* a : p->actives)
+    if (a->img_next < p->batch) return true;
+  return p->next_produce < p->n_batches && !p->free_slots.empty();
+}
+
 void worker_loop(Pipeline* p) {
   const uint64_t per_img = uint64_t(3) * p->aug.out_h * p->aug.out_w;
   for (;;) {
-    int bidx = -1;
-    Batch* slot = nullptr;
+    Active* act = nullptr;
+    int i = -1;
     {
       std::unique_lock<std::mutex> lk(p->mu);
       p->cv_work.wait(lk, [&] {
-        return p->stopping ||
-               (!p->paused && p->next_produce < p->n_batches &&
-                !p->free_slots.empty());
+        return p->stopping || work_available_locked(p);
       });
       if (p->stopping) return;
-      bidx = p->next_produce++;
-      p->in_flight++;
-      slot = p->free_slots.front();
-      p->free_slots.pop_front();
+      // earliest in-flight batch with unclaimed images first: completing
+      // batches in delivery order keeps the consumer unblocked
+      for (auto* a : p->actives)
+        if (a->img_next < p->batch) { act = a; break; }
+      if (act == nullptr) {
+        auto* a = new Active();
+        a->slot = p->free_slots.front();
+        p->free_slots.pop_front();
+        a->bidx = p->next_produce++;
+        a->img_next = 0;
+        a->remaining = p->batch;
+        a->slot->pad = 0;
+        a->slot->errors = 0;
+        p->actives.push_back(a);
+        act = a;
+        // more images than one just became claimable
+        p->cv_work.notify_all();
+      }
+      i = act->img_next++;
+      p->busy++;
     }
-    // deterministic per-record RNG: (seed, epoch, record position)
-    slot->pad = 0;
-    slot->errors = 0;
+    Batch* slot = act->slot;
+    int bidx = act->bidx;
+    // deterministic per-record RNG: (seed, epoch, record position) —
+    // output is identical for any thread count / schedule
     int n = int(p->order.size());
-    for (int i = 0; i < p->batch; ++i) {
-      int64_t pos = int64_t(bidx) * p->batch + i;
-      if (pos >= n) {
-        pos %= n;  // wrap: reference round_batch padding
-        slot->pad++;
-      }
-      uint32_t rec = p->order[pos];
-      std::mt19937 rng(uint32_t(p->seed * 1315423911u + p->epoch * 2654435761u +
-                                uint32_t(bidx * p->batch + i)));
-      const uint8_t* data;
-      uint64_t len;
-      IRView ir;
-      bool ok = rec_at(p->file, p->offsets[rec], &data, &len) &&
-                ir_parse(data, len, &ir);
-      float* out = slot->data.data() + uint64_t(i) * per_img;
-      float* lab = slot->labels.data() + uint64_t(i) * p->label_width;
-      // corrupt/undecodable records are zero-filled with label -1 so the
-      // consumer can mask them out; 0 would silently train as class 0
-      if (!ok) {
-        std::fill(out, out + per_img, 0.f);
-        std::fill(lab, lab + p->label_width, -1.f);
-        slot->errors++;
-        continue;
-      }
+    int64_t pos = int64_t(bidx) * p->batch + i;
+    bool wrapped = pos >= n;
+    if (wrapped) pos %= n;  // wrap: reference round_batch padding
+    uint32_t rec = p->order[pos];
+    std::mt19937 rng(uint32_t(p->seed * 1315423911u + p->epoch * 2654435761u +
+                              uint32_t(bidx * p->batch + i)));
+    const uint8_t* data;
+    uint64_t len;
+    IRView ir;
+    bool ok = rec_at(p->file, p->offsets[rec], &data, &len) &&
+              ir_parse(data, len, &ir);
+    float* outf = p->aug.u8_out ? nullptr
+                                : slot->data.data() + uint64_t(i) * per_img;
+    uint8_t* outu = p->aug.u8_out
+                        ? slot->data_u8.data() + uint64_t(i) * per_img
+                        : nullptr;
+    float* lab = slot->labels.data() + uint64_t(i) * p->label_width;
+    bool err = false;
+    // corrupt/undecodable records are zero-filled with label -1 so the
+    // consumer can mask them out; 0 would silently train as class 0
+    if (!ok) {
+      if (p->aug.u8_out)
+        std::fill(outu, outu + per_img, uint8_t(0));
+      else
+        std::fill(outf, outf + per_img, 0.f);
+      std::fill(lab, lab + p->label_width, -1.f);
+      err = true;
+    } else {
       for (int l = 0; l < p->label_width; ++l)
         lab[l] = ir.labels ? (l < int(ir.flag) ? ir.labels[l] : 0.f)
                            : (l == 0 ? ir.label : 0.f);
       bool dec_ok;
-      process_record(ir.img, ir.img_len, p->aug, out, &rng, &dec_ok);
+      process_record(ir.img, ir.img_len, p->aug, outf, outu, &rng, &dec_ok);
       if (!dec_ok) {
         std::fill(lab, lab + p->label_width, -1.f);
-        slot->errors++;
+        err = true;
       }
     }
     {
       std::lock_guard<std::mutex> lk(p->mu);
-      p->completed[bidx] = slot;
-      p->in_flight--;
+      p->busy--;
+      if (err) slot->errors++;
+      if (wrapped) slot->pad++;
+      if (--act->remaining == 0) {
+        p->completed[bidx] = slot;
+        p->actives.erase(
+            std::find(p->actives.begin(), p->actives.end(), act));
+        delete act;
+        p->cv_done.notify_all();
+      }
+      if (p->paused && p->busy == 0) p->cv_done.notify_all();
     }
-    p->cv_done.notify_all();
   }
 }
 
-// Requires p->mu held.
+// Requires p->mu held and no worker mid-image (busy == 0).
 void start_epoch_locked(Pipeline* p) {
   p->epoch++;
   if (p->shuffle) {
@@ -389,7 +455,41 @@ void start_epoch_locked(Pipeline* p) {
   p->next_deliver = 0;
   for (auto& kv : p->completed) p->free_slots.push_back(kv.second);
   p->completed.clear();
+  for (auto* a : p->actives) {  // partially-filled slots are discarded
+    p->free_slots.push_back(a->slot);
+    delete a;
+  }
+  p->actives.clear();
   p->paused = false;
+}
+
+// Shared delivery loop body (C++ linkage; the extern "C" entry points
+// below call it).  Blocks for the next in-order batch, hands it to
+// `emit`.  Returns: >=0 pad count, -1 epoch exhausted, -2 error.
+template <typename Emit>
+int pipeline_next_impl(Pipeline* p, Emit emit, int* errors) {
+  Batch* b = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->next_deliver >= p->n_batches) return -1;
+    int want = p->next_deliver;
+    p->cv_done.wait(lk, [&] {
+      return p->stopping || p->completed.count(want);
+    });
+    if (p->stopping) return -2;
+    b = p->completed[want];
+    p->completed.erase(want);
+    p->next_deliver++;
+  }
+  emit(b);
+  int pad = b->pad;
+  if (errors) *errors = b->errors;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->free_slots.push_back(b);
+  }
+  p->cv_work.notify_all();
+  return pad;
 }
 
 }  // namespace
@@ -447,7 +547,7 @@ void* mxtpu_pipeline_create(const char* rec_path, const uint64_t* offsets,
                             int label_width, int resize_short, int rand_crop,
                             int rand_mirror, const float* mean,
                             const float* stdv, int shuffle, uint64_t seed,
-                            int nthreads, int depth) {
+                            int nthreads, int depth, int u8_out) {
   if (n <= 0 || batch <= 0) return nullptr;
   RecFile* f = rec_open(rec_path);
   if (!f) return nullptr;
@@ -461,6 +561,7 @@ void* mxtpu_pipeline_create(const char* rec_path, const uint64_t* offsets,
   p->aug.resize_short = resize_short;
   p->aug.rand_crop = rand_crop;
   p->aug.rand_mirror = rand_mirror;
+  p->aug.u8_out = u8_out;
   for (int c = 0; c < 3; ++c) {
     p->aug.mean[c] = mean ? mean[c] : 0.f;
     p->aug.std[c] = stdv && stdv[c] > 0 ? stdv[c] : 1.f;
@@ -474,7 +575,10 @@ void* mxtpu_pipeline_create(const char* rec_path, const uint64_t* offsets,
   p->n_batches = int((n + batch - 1) / batch);
   p->slots.resize(p->depth);
   for (auto& s : p->slots) {
-    s.data.resize(uint64_t(batch) * 3 * out_h * out_w);
+    if (u8_out)
+      s.data_u8.resize(uint64_t(batch) * 3 * out_h * out_w);
+    else
+      s.data.resize(uint64_t(batch) * 3 * out_h * out_w);
     s.labels.resize(uint64_t(batch) * p->label_width);
     p->free_slots.push_back(&s);
   }
@@ -491,42 +595,34 @@ void* mxtpu_pipeline_create(const char* rec_path, const uint64_t* offsets,
   return p;
 }
 
-// Blocks for the next in-order batch; copies into `data`/`labels`.
-// Returns: >=0 pad count, -1 epoch exhausted (call reset), -2 error.
 int mxtpu_pipeline_next(void* h, float* data, float* labels, int* errors) {
   auto* p = static_cast<Pipeline*>(h);
-  Batch* b = nullptr;
-  {
-    std::unique_lock<std::mutex> lk(p->mu);
-    if (p->next_deliver >= p->n_batches) return -1;
-    int want = p->next_deliver;
-    p->cv_done.wait(lk, [&] {
-      return p->stopping || p->completed.count(want);
-    });
-    if (p->stopping) return -2;
-    b = p->completed[want];
-    p->completed.erase(want);
-    p->next_deliver++;
-  }
-  std::memcpy(data, b->data.data(), b->data.size() * sizeof(float));
-  std::memcpy(labels, b->labels.data(), b->labels.size() * sizeof(float));
-  int pad = b->pad;
-  if (errors) *errors = b->errors;
-  {
-    std::lock_guard<std::mutex> lk(p->mu);
-    p->free_slots.push_back(b);
-  }
-  p->cv_work.notify_all();
-  return pad;
+  if (p->aug.u8_out) return -2;  // wrong entry point for a u8 pipeline
+  return pipeline_next_impl(p, [&](Batch* b) {
+    std::memcpy(data, b->data.data(), b->data.size() * sizeof(float));
+    std::memcpy(labels, b->labels.data(), b->labels.size() * sizeof(float));
+  }, errors);
+}
+
+// u8 delivery (pipeline created with u8_out=1): raw NCHW uint8 planes,
+// 4x less host->device wire traffic; normalize on-device.
+int mxtpu_pipeline_next_u8(void* h, uint8_t* data, float* labels,
+                           int* errors) {
+  auto* p = static_cast<Pipeline*>(h);
+  if (!p->aug.u8_out) return -2;  // wrong entry point for an f32 pipeline
+  return pipeline_next_impl(p, [&](Batch* b) {
+    std::memcpy(data, b->data_u8.data(), b->data_u8.size());
+    std::memcpy(labels, b->labels.data(), b->labels.size() * sizeof(float));
+  }, errors);
 }
 
 void mxtpu_pipeline_reset(void* h) {
   auto* p = static_cast<Pipeline*>(h);
-  // Pause production, drain in-flight work, then restart — all under one
-  // mutex hold, so no worker can claim a batch between drain and restart.
+  // Pause production, drain workers mid-image, then restart — all under
+  // one mutex hold, so no worker can claim work between drain and restart.
   std::unique_lock<std::mutex> lk(p->mu);
   p->paused = true;
-  p->cv_done.wait(lk, [&] { return p->stopping || p->in_flight == 0; });
+  p->cv_done.wait(lk, [&] { return p->stopping || p->busy == 0; });
   if (p->stopping) return;
   start_epoch_locked(p);
   lk.unlock();

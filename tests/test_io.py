@@ -237,3 +237,63 @@ def test_dataloader_multiprocessing_shm():
     out = onp.concatenate([b.asnumpy() for b in dl2])
     onp.testing.assert_allclose(out, x * 2)
     dl2.close()
+
+
+def test_device_prefetch_iter_u8_normalize_and_order():
+    """DevicePrefetchIter: u8 wire batches arrive device-resident,
+    normalized (x-mean)/std in the target dtype, in order, pad preserved,
+    and reset restarts the stream (reference PrefetchingIter contract,
+    python/mxnet/io/io.py)."""
+    import numpy as onp
+    from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+    from mxnet_tpu.io import DevicePrefetchIter
+    import mxnet_tpu as mx
+
+    rs = onp.random.RandomState(0)
+    batches = [rs.randint(0, 255, (4, 3, 8, 8), dtype=onp.uint8)
+               for _ in range(5)]
+    labels = [onp.arange(4, dtype="float32") + 10 * i for i in range(5)]
+    mean = onp.array([100.0, 110.0, 120.0], "float32")
+    std = onp.array([50.0, 55.0, 60.0], "float32")
+
+    class U8Iter(DataIter):
+        def __init__(self):
+            super().__init__(4)
+            self.i = 0
+            self.mean = mean
+            self.std = std
+
+        @property
+        def provide_data(self):
+            return [DataDesc("data", (4, 3, 8, 8))]
+
+        @property
+        def provide_label(self):
+            return [DataDesc("softmax_label", (4,))]
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= len(batches):
+                raise StopIteration
+            b = DataBatch([mx.nd.array(batches[self.i], dtype="uint8")],
+                          [mx.nd.array(labels[self.i])],
+                          pad=1 if self.i == len(batches) - 1 else 0)
+            self.i += 1
+            return b
+
+    feed = DevicePrefetchIter(U8Iter(), dtype="float32")
+    got = list(feed)
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        want = (batches[i].astype("float32")
+                - mean.reshape(1, 3, 1, 1)) / std.reshape(1, 3, 1, 1)
+        onp.testing.assert_allclose(b.data[0].asnumpy(), want, rtol=1e-6)
+        onp.testing.assert_allclose(b.label[0].asnumpy(), labels[i])
+        assert b.pad == (1 if i == 4 else 0)
+    feed.reset()
+    again = list(feed)
+    assert len(again) == 5
+    onp.testing.assert_allclose(again[2].data[0].asnumpy(),
+                                got[2].data[0].asnumpy())

@@ -167,3 +167,59 @@ def test_pipeline_shuffle_deterministic(rec_file):
         p.close()
     assert onp.array_equal(outs[0][0], outs[1][0])
     assert onp.array_equal(outs[0][1], outs[1][1])
+
+
+def test_pipeline_thread_count_invariant(rec_file):
+    """Per-image work stealing must be schedule-independent: any thread
+    count yields bit-identical batches (augment RNG is keyed on (seed,
+    epoch, record position), not on worker assignment)."""
+    f = native.NativeRecordFile(rec_file["jrec"])
+    offs = f.scan()
+    f.close()
+    outs = []
+    for nthreads in (1, 4, 8):
+        p = native.NativeImagePipeline(
+            rec_file["jrec"], offs, batch_size=6, data_shape=(3, 16, 16),
+            shuffle=True, seed=17, preprocess_threads=nthreads,
+            rand_crop=True, rand_mirror=True, prefetch_buffer=3)
+        epoch = []
+        while True:
+            out = p.next()
+            if out is None:
+                break
+            epoch.append((out[0].copy(), out[1].copy(), out[2]))
+        outs.append(epoch)
+        p.close()
+    for other in outs[1:]:
+        assert len(other) == len(outs[0])
+        for (d0, l0, p0), (d1, l1, p1) in zip(outs[0], other):
+            assert onp.array_equal(d0, d1)
+            assert onp.array_equal(l0, l1)
+            assert p0 == p1
+
+
+def test_pipeline_u8_output_parity(rec_file):
+    """u8 mode returns the raw crop planes; normalizing them on the host
+    must reproduce the f32 mode exactly (same RNG keying)."""
+    f = native.NativeRecordFile(rec_file["jrec"])
+    offs = f.scan()
+    f.close()
+    mean = [123.68, 116.78, 103.94]
+    std = [58.4, 57.12, 57.38]
+    kw = dict(batch_size=8, data_shape=(3, 16, 16), shuffle=True, seed=9,
+              preprocess_threads=3, rand_crop=True, rand_mirror=True,
+              mean=mean, std=std)
+    pf = native.NativeImagePipeline(rec_file["jrec"], offs, **kw)
+    pu = native.NativeImagePipeline(rec_file["jrec"], offs, u8_output=True,
+                                    **kw)
+    for _ in range(pf.num_batches):
+        df, lf, padf, ef = pf.next()
+        du, lu, padu, eu = pu.next()
+        assert du.dtype == onp.uint8
+        norm = (du.astype(onp.float32)
+                - onp.asarray(mean, onp.float32).reshape(1, 3, 1, 1)) \
+            / onp.asarray(std, onp.float32).reshape(1, 3, 1, 1)
+        onp.testing.assert_allclose(norm, df, rtol=0, atol=1e-5)
+        assert onp.array_equal(lf, lu) and padf == padu and ef == eu
+    pf.close()
+    pu.close()
