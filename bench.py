@@ -382,19 +382,24 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
             std_r=58.4, std_g=57.12, std_b=57.38, preprocess_threads=8,
             u8_output=True)
 
+    def _sync_scalar(nd):
+        # one-element D2H sync: a full asnumpy() would drag the whole
+        # batch back through the ~5 MB/s tunnel inside the timed window
+        return float(onp.asarray(nd[0, 0, 0, 0].asnumpy()))
+
     feed = DevicePrefetchIter(fresh_u8_iter(), dtype="bfloat16")
     n = 0
     last = None
     t0 = None
     for batch in feed:
         if t0 is None:  # exclude normalize-jit compile from the steady rate
-            _sync(batch.data[0])
+            _sync_scalar(batch.data[0])
             t0 = time.perf_counter()
             continue
         n += batch.data[0].shape[0]
         last = batch.data[0]
     if last is not None:
-        _sync(last)     # one sync: transfers pipeline, like a real feed
+        _sync_scalar(last)  # one sync: transfers pipeline, like a real feed
     wire_rate = n / (time.perf_counter() - t0) if n else 0.0
     feed.close()
 
@@ -415,14 +420,15 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
     n = 0
     t0 = None
     for batch in feed:
-        if t0 is None:  # first batch pays the normalize-jit compile:
-            _sync(batch.data[0])        # exclude it, as in leg (b)
-            t0 = time.perf_counter()
+        if t0 is None:  # first batch pays the normalize-jit compile and
+            _sync_scalar(batch.data[0])  # its wire transfer precedes t0:
+            t0 = time.perf_counter()     # exclude it entirely, as leg (b)
+            continue
         loss = step(batch.data[0], batch.label[0])
         n += batch.data[0].shape[0]
     if loss is not None:
         _sync(loss)
-    e2e_rate = n / (time.perf_counter() - t0) if t0 else 0.0
+    e2e_rate = n / (time.perf_counter() - t0) if (t0 and n) else 0.0
     feed.close()
 
     shutil.rmtree(d, ignore_errors=True)
@@ -433,8 +439,9 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
     # host both legs scale: decode ~linearly in cores (per-image work
     # stealing), wire is local DMA.  The honest host-side roofline ships
     # in the artifact: decode_cores and the per-core decode rate.
-    import os as _os
-    cores = _os.cpu_count() or 1
+    # the pool runs preprocess_threads=8 workers, so at most
+    # min(cores, 8) cores can be decoding — the honest per-core divisor
+    cores = min(os.cpu_count() or 1, 8)
     return {"bench": "input_pipeline", "batch_size": batch_size,
             "n_images": n_images, "image_size": image_size,
             "wire_format": "uint8+device_normalize",

@@ -118,8 +118,12 @@ class _NativeImageRecordIter(DataIter):
 
     @property
     def provide_data(self):
+        # u8 mode advertises its real dtype: raw pixels, mean/std NOT
+        # applied — consumers other than DevicePrefetchIter (which
+        # normalizes on-device) must opt in knowingly
+        dtype = onp.uint8 if self.u8_output else onp.float32
         return [DataDesc(self.data_name,
-                         (self.batch_size,) + self.data_shape)]
+                         (self.batch_size,) + self.data_shape, dtype=dtype)]
 
     @property
     def provide_label(self):

@@ -22,7 +22,7 @@ import launch  # noqa: E402  (tools/launch.py)
 _WORKER = os.path.join(_REPO, "tests", "dist_worker.py")
 
 
-@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("n", [2, 8])
 def test_dist_sync_kvstore_multiprocess(n):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -30,6 +30,20 @@ def test_dist_sync_kvstore_multiprocess(n):
     env.pop("XLA_FLAGS", None)
     codes = launch.launch_local(n, [sys.executable, _WORKER], env=env)
     assert codes == [0] * n, codes
+
+
+def test_dist_hybrid_topology_2x4():
+    """2 processes x 4 virtual devices each: DCN x ICI hybrid mesh.
+    The worker asserts bitwise-exact hybrid-sharded gradient aggregation,
+    ring attention over a process-spanning sp axis, and a pipeline whose
+    pp axis is the process boundary (see dist_worker_hybrid.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    codes = launch.launch_local(
+        2, [sys.executable, os.path.join(_REPO, "tests",
+                                         "dist_worker_hybrid.py")], env=env)
+    assert codes == [0, 0], codes
 
 
 def test_dist_init_failure_is_hard():
