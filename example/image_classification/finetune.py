@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Transfer learning / finetune from upstream ``.params`` (reference
+docs/faq/finetune.md; example/image-classification/fine-tune.py).
+
+Flow:
+  1. a "pretrained" ResNet-18 checkpoint is written in the upstream
+     binary ``.params`` format (the same dmlc NDArray container real
+     MXNet ships — mxnet_tpu reads/writes it bit-compatibly);
+  2. a fresh zoo net with a DIFFERENT number of classes loads the
+     feature weights from that checkpoint (head skipped);
+  3. only the new head trains at full lr (features frozen via
+     grad_req='null'), on a synthetic 3-class color task;
+  4. prints FINAL_ACC for the smoke test.
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+from mxnet_tpu.gluon.utils import materialize_params  # noqa: E402
+
+
+def synthetic_batches(n_batches, batch_size, size, rs):
+    """3-class task: class = brightest channel."""
+    for _ in range(n_batches):
+        y = rs.randint(0, 3, batch_size)
+        x = rs.uniform(0, 0.3, (batch_size, 3, size, size)).astype("float32")
+        for i, c in enumerate(y):
+            x[i, c] += 0.6
+        yield mx.nd.array(x), mx.nd.array(y.astype("float32"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batches-per-epoch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--params", default="")
+    args = ap.parse_args()
+    rs = onp.random.RandomState(0)
+
+    params_file = args.params
+    if not params_file:
+        # 1) fabricate the "upstream checkpoint": a 1000-class ResNet-18
+        src = vision.resnet18_v1(classes=1000)
+        src.initialize(mx.init.Xavier())
+        materialize_params(src, mx.nd.zeros(
+            (1, 3, args.image_size, args.image_size)))
+        params_file = "/tmp/finetune_src.params"
+        # upstream BINARY .params container (dmlc NDArray list format),
+        # exactly what a real-MXNet deployment ships
+        from mxnet_tpu.ndarray.legacy_io import is_legacy_file, save_legacy
+        sp = src._collect_params_with_prefix()
+        save_legacy(params_file,
+                    {k: v.data().asnumpy() for k, v in sp.items()
+                     if v._data is not None})
+        assert is_legacy_file(params_file), \
+            "checkpoint must be upstream binary format"
+
+    # 2) fresh net, NEW head (3 classes); load feature weights only
+    net = vision.resnet18_v1(classes=3)
+    net.initialize(mx.init.Xavier())
+    materialize_params(net, mx.nd.zeros(
+        (1, 3, args.image_size, args.image_size)))
+    # the classic finetune surgery (reference fine-tune.py get_fine_tune_
+    # model): take every feature tensor from the checkpoint, drop the old
+    # 1000-way head
+    loaded = mx.nd.load(params_file)
+    fp = net.features._collect_params_with_prefix()
+    n_loaded = 0
+    for k, p in fp.items():
+        src_k = "features." + k
+        if src_k in loaded:
+            p.set_data(loaded[src_k].astype(p.dtype))
+            n_loaded += 1
+    assert n_loaded == len(fp), (n_loaded, len(fp))
+    assert not any(k.startswith("output.") and "3" in str(loaded[k].shape)
+                   for k in loaded), "old head is 1000-way"
+    after = {k: v.data().asnumpy() for k, v
+             in net.features.collect_params().items()}
+    print("loaded %d feature tensors from %s" % (n_loaded, params_file))
+
+    # 3) freeze features, train only the head
+    for _, p in net.features.collect_params().items():
+        p.grad_req = "null"
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+
+    acc = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        acc.reset()
+        for x, y in synthetic_batches(args.batches_per_epoch,
+                                      args.batch_size, args.image_size, rs):
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y).mean()
+            loss.backward()
+            trainer.step(1)
+            acc.update([y], [out])
+        print("epoch %d acc %.3f" % (epoch, acc.get()[1]))
+
+    # frozen feature WEIGHTS must be untouched by training (BN moving
+    # stats still track batch statistics in train mode — the reference's
+    # frozen-backbone finetune behaves the same)
+    final = {k: v.data().asnumpy() for k, v
+             in net.features.collect_params().items()}
+    for k in after:
+        if "moving_" in k or "running_" in k:
+            continue
+        onp.testing.assert_array_equal(after[k], final[k])
+    print("FINAL_ACC %.3f" % acc.get()[1])
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,9 @@ from . import visualization as viz
 from . import runtime
 from . import engine
 from . import subgraph
+from . import attribute
+from . import name
+from .attribute import AttrScope
 
 # convenience re-exports matching `import mxnet as mx` usage
 from .ndarray import NDArray

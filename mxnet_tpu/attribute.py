@@ -1,0 +1,57 @@
+"""Symbol attribute scoping (reference ``python/mxnet/attribute.py:27``).
+
+``AttrScope`` applies a set of string attributes to every symbol created
+inside its ``with`` block — the mechanism behind ``ctx_group`` model-
+parallel annotations, ``lr_mult``/``wd_mult`` scoping, and user metadata.
+Scopes nest (inner values win), are thread-local, and merge with per-call
+``attr=`` dicts exactly as the reference's ``AttrScope.get`` does.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_current = threading.local()
+
+
+class AttrScope:
+    """Attribute manager for scoping (``with mx.AttrScope(x='y'): …``)."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("Attributes need to be string")
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr):
+        """Merge this scope's attributes under the user's ``attr`` dict
+        (user values win), returning a dict."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return dict(attr) if attr else {}
+
+    def __enter__(self):
+        if not hasattr(_current, "value"):
+            _current.value = AttrScope()
+        self._old_scope = _current.value
+        attr = _current.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        _current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope is not None
+        _current.value = self._old_scope
+
+
+def current() -> AttrScope:
+    """The active scope for this thread (creating the default lazily)."""
+    if not hasattr(_current, "value"):
+        _current.value = AttrScope()
+    return _current.value

@@ -1,10 +1,10 @@
 """Data iterators (reference ``python/mxnet/io/``)."""
 from .io import (  # noqa: F401
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
-    CSVIter, MNISTIter)
+    CSVIter, MNISTIter, LibSVMIter)
 from .image_record_iter import ImageRecordIter  # noqa: F401
 from .device_prefetch import DevicePrefetchIter  # noqa: F401
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "DevicePrefetchIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "LibSVMIter",
+           "ImageRecordIter", "DevicePrefetchIter"]

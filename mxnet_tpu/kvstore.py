@@ -162,6 +162,14 @@ class KVStore:
     def type(self) -> str:
         return self._type
 
+    def num_dead_node(self, node_id: int = 0, timeout: int = 5) -> int:
+        """Count of unreachable workers (reference
+        include/mxnet/kvstore.h:353 ``get_num_dead_node``; the ps-lite
+        role predicate family).  Single-process stores have no peers."""
+        return 0
+
+    get_num_dead_node = num_dead_node
+
     def barrier(self):
         pass
 
@@ -358,6 +366,33 @@ class KVStoreTPU(KVStoreLocal):
     def num_workers(self) -> int:
         import jax
         return jax.process_count()
+
+    def num_dead_node(self, node_id: int = 0, timeout: int = 5) -> int:
+        """Number of peer processes the coordination service reports as
+        NOT live (reference include/mxnet/kvstore.h:353
+        ``get_num_dead_node`` over ps-lite's heartbeat tracking; here the
+        jax coordination service's liveness view).  ``node_id`` is
+        accepted for API parity — the coordination service tracks worker
+        processes, not ps-lite's scheduler/server node ids."""
+        import jax
+        from jax._src import distributed as _dist
+
+        client = getattr(_dist.global_state, "client", None)
+        if client is None:
+            return 0
+        ids = list(range(jax.process_count()))
+        try:
+            live = client.get_live_nodes(ids)
+        except Exception as e:
+            # don't guess a count from a failed probe — surface the
+            # coordinator state to the caller (a transient RPC error must
+            # not masquerade as "everyone is dead")
+            raise MXNetError(
+                "num_dead_node: coordination service unreachable: %r"
+                % (e,)) from e
+        return len(ids) - sum(1 for i in ids if i in live)
+
+    get_num_dead_node = num_dead_node
 
     def barrier(self):
         from .ndarray import waitall

@@ -202,6 +202,7 @@ class Module(BaseModule):
 
         import jax
         dev = self._contexts[0].jax_device
+        attrs = self._symbol.attr_dict()
 
         def _impl(name, arr, cache):
             if cache is not None and name in cache:
@@ -215,7 +216,9 @@ class Module(BaseModule):
             elif cache is not None and not allow_missing:
                 raise MXNetError("%s is not presented" % name)
             elif initializer is not None:
-                initializer(InitDesc(name), arr)
+                # per-variable __init__ / metadata reach the initializer
+                # (reference module.py InitDesc(name, attrs))
+                initializer(InitDesc(name, attrs.get(name)), arr)
 
         for name in self._param_names:
             _impl(name, self._exec.arg_dict[name], arg_params)

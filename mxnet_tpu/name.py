@@ -1,0 +1,65 @@
+"""Automatic symbol naming (reference ``python/mxnet/name.py:25``).
+
+``NameManager`` turns a hint into ``hint0, hint1, …``; ``Prefix`` prepends
+a fixed prefix.  Managers nest with ``with`` and are thread-local, exactly
+like the reference's ``_current = threading.local()`` design — symbolic
+user code that managed names upstream keeps working unchanged.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_current = threading.local()
+
+
+class NameManager:
+    """Scoped automatic namer: user-provided names pass through, missing
+    names become ``'%s%d' % (hint, counter[hint]++)``."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        cnt = self._counter.get(hint, 0)
+        self._counter[hint] = cnt + 1
+        return "%s%d" % (hint, cnt)
+
+    def __enter__(self):
+        if not hasattr(_current, "value"):
+            _current.value = NameManager()
+        self._old_manager = _current.value
+        _current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager is not None
+        _current.value = self._old_manager
+
+    # reference-compatible accessor (deprecated there, kept callable)
+    @property
+    def current(self):
+        return current()
+
+
+class Prefix(NameManager):
+    """Name manager that attaches a prefix to every generated name
+    (reference name.py Prefix): ``with mx.name.Prefix('mynet_'): …``."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current() -> NameManager:
+    """The active manager for this thread (creating the default lazily)."""
+    if not hasattr(_current, "value"):
+        _current.value = NameManager()
+    return _current.value

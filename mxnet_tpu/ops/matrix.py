@@ -334,6 +334,51 @@ def linalg_syrk(A, transpose: bool = False, alpha: float = 1.0):
     return alpha * (jnp.matmul(a_t, A) if transpose else jnp.matmul(A, a_t))
 
 
+@register("linalg_gelqf")
+def linalg_gelqf(A):
+    """LQ factorization A = L·Q (reference src/operator/tensor/la_op.cc:752
+    gelqf, LAPACK dgelqf+dorglq): A (…, m, n) with m <= n; returns
+    (Q (…, m, n) with orthonormal rows, L (…, m, m) lower-triangular).
+    TPU-native via QR of Aᵀ: Aᵀ = Q̃R̃  ⇒  A = R̃ᵀ Q̃ᵀ = L Q, with signs
+    fixed so diag(L) > 0 (the LAPACK convention the reference exposes)."""
+    qt, rt = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    L = jnp.swapaxes(rt, -1, -2)
+    Q = jnp.swapaxes(qt, -1, -2)
+    # canonical sign: positive diagonal of L
+    d = jnp.diagonal(L, axis1=-2, axis2=-1)
+    s = jnp.where(d < 0, -1.0, 1.0).astype(A.dtype)
+    L = L * s[..., None, :]          # scale columns of L
+    Q = Q * s[..., :, None]          # and matching rows of Q
+    return Q, L
+
+
+@register("_ravel_multi_index", aliases=("ravel_multi_index",))
+def ravel_multi_index(data, shape=()):
+    """Reference src/operator/tensor/ravel.cc: multi-index (d, N) ->
+    flat indices (N,) over ``shape``."""
+    shape = tuple(int(s) for s in shape)
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.tensordot(strides, data, axes=((0,), (0,)))
+
+
+@register("_unravel_index", aliases=("unravel_index",))
+def unravel_index(data, shape=()):
+    """Reference src/operator/tensor/ravel.cc: flat indices (N,) ->
+    multi-index (d, N) over ``shape``."""
+    shape = tuple(int(s) for s in shape)
+    idx = data.astype(jnp.int32)   # x32 JAX default; shapes < 2^31
+    outs = []
+    for s in reversed(shape):
+        outs.append(idx % s)
+        idx = idx // s
+    return jnp.stack(list(reversed(outs))).astype(data.dtype)
+
+
 @register("linalg_extractdiag")
 def linalg_extractdiag(A, offset: int = 0):
     return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)

@@ -12,6 +12,8 @@ assignment re-tiles for the TPU's native layouts internally.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -471,6 +473,103 @@ def softmax_output(data, label, grad_scale: float = 1.0, ignore_label: float = -
     x = data.reshape(data.shape[0], -1)
     out = _softmax_output_core(x, label.reshape(-1))
     return out.reshape(data.shape) if preserve_shape else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output_core(data, label, margin, reg, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg, use_linear, res, g):
+    # like SoftmaxOutput, the head grad is IGNORED: the op IS the loss head
+    # (reference svm_output.cc L1_SVM/L2_SVM kernels)
+    x, label = res
+    k = jax.nn.one_hot(label.astype(jnp.int32), x.shape[-1],
+                       dtype=x.dtype) > 0
+    if use_linear:      # L1-SVM: +-reg on margin violations
+        at_k = -(margin > x).astype(x.dtype) * reg
+        off_k = (margin > -x).astype(x.dtype) * reg
+    else:               # L2-SVM (default): linear-in-violation magnitude
+        at_k = jnp.where(margin > x, 2.0 * (margin - x), 0.0) * -reg
+        off_k = jnp.where(margin > -x, -2.0 * (margin + x), 0.0) * -reg
+    dx = jnp.where(k, at_k, off_k).astype(x.dtype)
+    return dx, jnp.zeros_like(label)
+
+
+_svm_output_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, margin: float = 1.0,
+               regularization_coefficient: float = 1.0,
+               use_linear: bool = False):
+    """Reference src/operator/svm_output.cc: forward = identity; backward
+    replaces the head grad with the hinge-loss gradient (L2-SVM by
+    default, L1-SVM with ``use_linear``), scaled by
+    ``regularization_coefficient``."""
+    x = data.reshape(data.shape[0], -1)
+    out = _svm_output_core(x, label.reshape(-1), float(margin),
+                           float(regularization_coefficient),
+                           bool(use_linear))
+    return out.reshape(data.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _kl_sparse_core(data, moving_avg, sparseness_target, penalty, momentum,
+                    has_ma):
+    return data
+
+
+def _klsr_fwd(data, moving_avg, sparseness_target, penalty, momentum,
+              has_ma):
+    return data, (data, moving_avg, has_ma)
+
+
+def _klsr_bwd(sparseness_target, penalty, momentum, has_ma, res, g):
+    x, moving_avg, _ = res
+    rho = sparseness_target
+    avg = jnp.mean(x, axis=0)                         # per-unit activation
+    # momentum applies only against a caller-carried running average; a
+    # fresh call uses the batch average directly (a zero-initialized ma
+    # would shrink the denominator 10x and explode the penalty)
+    ma = momentum * moving_avg + (1.0 - momentum) * avg if has_ma else avg
+    # dead units (avg == 0) must not emit -rho/0 = -inf gradients
+    eps = 1e-6
+    ma = jnp.clip(ma, eps, 1.0 - eps)
+    kl = penalty * (-rho / ma + (1.0 - rho) / (1.0 - ma))
+    return (g + jnp.broadcast_to(kl, x.shape).astype(x.dtype),
+            jnp.zeros_like(moving_avg))
+
+
+_kl_sparse_core.defvjp(_klsr_fwd, _klsr_bwd)
+
+
+@register("IdentityAttachKLSparseReg",
+          aliases=("identity_attach_KL_sparse_reg",))
+def identity_attach_kl_sparse_reg(data, moving_avg=None,
+                                  sparseness_target: float = 0.1,
+                                  penalty: float = 0.001,
+                                  momentum: float = 0.9):
+    """Reference src/operator/identity_attach_KL_sparse_reg.cc: forward is
+    identity; backward adds the KL-divergence sparseness penalty
+    ``penalty * (-rho/ma + (1-rho)/(1-ma))``.  ``ma`` is the
+    momentum-blend of a caller-carried running average with the batch
+    average when ``moving_avg`` is supplied (the reference's aux state,
+    which the caller updates as ``momentum*ma + (1-momentum)*batch_avg``
+    between steps), or simply the batch average when it is not; the
+    denominator is clamped away from 0/1 so dead units cannot emit
+    infinite gradients."""
+    x = data.reshape(data.shape[0], -1)
+    has_ma = moving_avg is not None
+    if not has_ma:
+        moving_avg = jnp.zeros((x.shape[-1],), x.dtype)
+    out = _kl_sparse_core(x, moving_avg, float(sparseness_target),
+                          float(penalty), float(momentum), has_ma)
+    return out.reshape(data.shape)
 
 
 @register("softmax_cross_entropy")

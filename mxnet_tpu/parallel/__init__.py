@@ -87,6 +87,18 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         kw["num_processes"] = num_processes
     if process_id is not None:
         kw["process_id"] = process_id
+    if "MXNET_TPU_HEARTBEAT_TIMEOUT" in os.environ:
+        # failure-detection latency knob (reference: ps-lite
+        # PS_HEARTBEAT_TIMEOUT, docs/faq/env_var.md DMLC heartbeat family)
+        kw["heartbeat_timeout_seconds"] = int(
+            os.environ["MXNET_TPU_HEARTBEAT_TIMEOUT"])
+    if os.environ.get("MXNET_TPU_RECOVERABLE", "") in ("1", "true"):
+        # survive peer failure instead of fail-fast: the kvstore's
+        # num_dead_node() liveness view stays queryable after a worker
+        # dies (reference get_num_dead_node semantics — survivors keep
+        # running; fail-fast remains the default, matching round-3's
+        # hard-failure contract)
+        jax.config.update("jax_enable_recoverability", True)
     jax.distributed.initialize(**kw)
 
 

@@ -13,7 +13,7 @@ import inspect
 from ..ops.registry import get_op, list_ops
 from .symbol import (  # noqa: F401
     Symbol, var, Variable, Group, load, load_json, zeros, ones,
-    _SymNode, _NAMES,
+    _SymNode,
 )
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
@@ -23,7 +23,7 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
 # Optional learnable/label inputs auto-created as variables when omitted
 # (reference: ListArguments names from the op's FListInputNames).
 _OPTIONAL_INPUTS = ("weight", "bias", "gamma", "beta",
-                    "moving_mean", "moving_var", "label")
+                    "moving_mean", "moving_var", "label", "state_cell")
 
 # per-op gating of optional inputs: (param, attr-predicate) — the input
 # exists only when the predicate over attrs holds (reference examples:
@@ -90,18 +90,24 @@ _VARARG_OPS = {"Concat", "concat", "add_n", "ElementWiseSum",
                "elemwise_sum", "stack"}
 
 
-def _invoke_op(op_name, inputs, attrs, name=None, in_names=None):
+def _invoke_op(op_name, inputs, attrs, name=None, in_names=None,
+               user_attrs=None):
     """Create a Symbol node applying ``op_name`` to input Symbols."""
+    from .. import attribute as _attribute
+    from .. import name as _name_mod
+
     op = get_op(op_name)
     if op is None and op_name not in _VARARG_OPS:
         raise ValueError("unknown op %r" % op_name)
-    if name is None:
+    if name is None:   # sym.func wrappers name before calling _invoke_op
         hint = (op.name if op is not None else op_name).lower().replace(
             ".", "_").lstrip("_")
-        name = _NAMES.get(hint)
+        name = _name_mod.current().get(None, hint)
+    # AttrScope metadata rides on the node separately from op params
+    scoped = _attribute.current().get(user_attrs)
     entries = [s._entries[0] for s in inputs]
     node = _SymNode(op_name, name, dict(attrs), entries,
-                    in_names=in_names)
+                    in_names=in_names, user_attrs=scoped)
     return Symbol([(node, i) for i in range(node.num_outputs)])
 
 
@@ -117,10 +123,12 @@ def _make_sym_func(op):
         return cached
 
     def func(*args, **kwargs):
+        from .. import name as _name_mod
+
         name = kwargs.pop("name", None)
-        kwargs.pop("attr", None)
-        if name is None:
-            name = _NAMES.get(op.name.lower().replace(".", "_").lstrip("_"))
+        user_attr = kwargs.pop("attr", None)
+        name = _name_mod.current().get(
+            name, op.name.lower().replace(".", "_").lstrip("_"))
         attrs = {}
         given = {}
         # positional args map onto the full signature: Symbols must land on
@@ -168,7 +176,7 @@ def _make_sym_func(op):
             raise TypeError("unexpected Symbol arguments %r for op %s"
                             % (sorted(given), op.name))
         return _invoke_op(op.name, inputs, attrs, name=name,
-                          in_names=in_names)
+                          in_names=in_names, user_attrs=user_attr)
 
     func.__name__ = op.name
     func.__doc__ = op.doc

@@ -16,7 +16,6 @@ per-op rule table mirroring the reference's FInferShape functions.
 from __future__ import annotations
 
 import json
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as onp
@@ -28,17 +27,8 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
            "zeros", "ones"]
 
 
-class _NameManager(threading.local):
-    def __init__(self):
-        self.counts = {}
-
-    def get(self, hint):
-        cnt = self.counts.get(hint, 0)
-        self.counts[hint] = cnt + 1
-        return "%s%d" % (hint, cnt)
-
-
-_NAMES = _NameManager()
+# automatic naming lives in mxnet_tpu.name (NameManager/Prefix, the
+# reference's python/mxnet/name.py); symbol creation calls name.current()
 
 # input param names that are auxiliary states (reference: mutable inputs
 # declared by FMutateInputs, e.g. BatchNorm's moving stats)
@@ -57,14 +47,23 @@ def _rnn_num_outputs(attrs):
 
 
 class _SymNode:
-    """One graph node: an op application or a variable (op=None)."""
+    """One graph node: an op application or a variable (op=None).
 
-    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "in_names")
+    ``attrs`` holds the op's declared parameters (fed to the kernel at
+    eval); ``user_attrs`` holds AttrScope / ``attr=`` metadata strings
+    (``ctx_group``, ``__lr_mult__``, …) which ride on the node and its
+    JSON but never reach a kernel call — the split the reference gets
+    from dmlc's allow-unknown param parsing."""
 
-    def __init__(self, op, name, attrs, inputs, in_names=None):
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "in_names",
+                 "user_attrs")
+
+    def __init__(self, op, name, attrs, inputs, in_names=None,
+                 user_attrs=None):
         self.op = op  # str | None
         self.name = name
         self.attrs = attrs or {}
+        self.user_attrs = user_attrs or {}
         self.inputs = inputs  # list of (node, out_idx)
         if in_names is None and op is not None:
             from . import _input_params, _VARARG_OPS
@@ -104,11 +103,29 @@ class Symbol:
 
     def attr(self, key):
         if len(self._entries) == 1:
-            return self._entries[0][0].attrs.get(key)
+            node = self._entries[0][0]
+            if key in node.user_attrs:
+                return node.user_attrs[key]
+            return node.attrs.get(key)
         return None
 
     def list_attr(self):
-        return dict(self._entries[0][0].attrs)
+        node = self._entries[0][0]
+        merged = dict(node.attrs)
+        merged.update(node.user_attrs)
+        return merged
+
+    def attr_dict(self):
+        """{node_name: merged attrs} over the whole graph (reference
+        symbol.py attr_dict) — what Module feeds InitDesc so per-variable
+        ``__init__``/``__lr_mult__`` annotations reach the initializer."""
+        out = {}
+        for node in self._topo():
+            merged = dict(node.attrs)
+            merged.update(node.user_attrs)
+            if merged:
+                out[node.name] = merged
+        return out
 
     def __getitem__(self, index):
         if isinstance(index, str):
@@ -307,10 +324,12 @@ class Symbol:
             # every attr value is json.dumps'ed (strings included) so load
             # can json.loads unambiguously; reference JSON (plain strings)
             # still loads via the fallback in load_json
-            out_nodes.append({
+            all_attrs = dict(n.attrs)
+            all_attrs.update(n.user_attrs)       # one attrs dict, like the
+            out_nodes.append({                   # reference's node JSON
                 "op": n.op if n.op is not None else "null",
                 "name": n.name,
-                "attrs": {k: json.dumps(v) for k, v in n.attrs.items()},
+                "attrs": {k: json.dumps(v) for k, v in all_attrs.items()},
                 "inputs": [[nid[id(c)], i, 0] for c, i in n.inputs],
             })
         arg_nodes = [i for i, n in enumerate(nodes) if n.op is None]
@@ -407,7 +426,8 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     """Create a symbolic variable (reference symbol.py var/Variable)."""
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable name")
-    attrs = dict(attr or {})
+    from .. import attribute as _attribute
+    attrs = _attribute.current().get(attr)
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if lr_mult is not None:
@@ -445,6 +465,21 @@ def ones(shape, dtype=None, **kwargs):
                                     "dtype": dtype or "float32"})
 
 
+_SIG_NAME_CACHE: Dict[str, object] = {}
+
+
+def _op_sig_names(op_name):
+    """Memoized signature-parameter name-set of a registered op (None for
+    unknown/vararg ops) — load_json splits metadata attrs with it."""
+    if op_name not in _SIG_NAME_CACHE:
+        import inspect
+        opdef = get_op(op_name)
+        _SIG_NAME_CACHE[op_name] = (
+            None if opdef is None
+            else frozenset(inspect.signature(opdef.fn).parameters))
+    return _SIG_NAME_CACHE[op_name]
+
+
 def load_json(json_str):
     """Rebuild a Symbol from graph JSON (reference load_json)."""
     data = json.loads(json_str)
@@ -464,8 +499,17 @@ def load_json(json_str):
                  for k, v in attrs.items()}
         op = spec["op"]
         inputs = [(nodes[nid], out_idx) for nid, out_idx, _ in spec["inputs"]]
+        user_attrs = {}
+        if op != "null":
+            # split metadata attrs back out: anything not in the op's
+            # declared signature is user/scope metadata, not a kernel param
+            sig_names = _op_sig_names(op)
+            if sig_names is not None:
+                user_attrs = {k: v for k, v in attrs.items()
+                              if k not in sig_names}
+                attrs = {k: v for k, v in attrs.items() if k in sig_names}
         node = _SymNode(None if op == "null" else op, spec["name"], attrs,
-                        inputs)
+                        inputs, user_attrs=user_attrs)
         nodes.append(node)
     entries = [(nodes[nid], idx) for nid, idx, _ in data["heads"]]
     return Symbol(entries)

@@ -1,0 +1,48 @@
+"""Worker body for the liveness / failure-detection test (reference
+``include/mxnet/kvstore.h:353`` get_num_dead_node over ps-lite heartbeats;
+here the jax coordination service's live-nodes view).
+
+3 processes: rank 2 dies (os._exit, no cleanup — a crash, not a clean
+shutdown) right after joining; ranks 0 and 1 must observe
+``kv.num_dead_node()`` transition 0 -> 1 within the polling window.
+"""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXNET_TPU_RECOVERABLE"] = "1"      # survivors keep running
+os.environ["MXNET_TPU_HEARTBEAT_TIMEOUT"] = "10"  # fast failure detection
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu import kvstore, parallel
+
+    parallel.initialize()
+    assert jax.process_count() == 3
+    kv = kvstore.create("dist_sync")
+
+    if kv.rank == 2:
+        # crash without any coordination-service cleanup
+        sys.stdout.flush()
+        os._exit(0)
+
+    # freshly joined: everyone alive (allow the service a beat to settle)
+    assert kv.num_dead_node(timeout=5) in (0, 1)
+
+    deadline = time.time() + 90
+    seen_dead = 0
+    while time.time() < deadline:
+        seen_dead = kv.num_dead_node(timeout=5)
+        if seen_dead >= 1:
+            break
+        time.sleep(1.0)
+    assert seen_dead >= 1, "rank 2 died but num_dead_node stayed 0"
+    print("KILL-WORKER %d OK (dead=%d)" % (kv.rank, seen_dead))
+
+
+if __name__ == "__main__":
+    main()

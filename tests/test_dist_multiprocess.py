@@ -46,6 +46,19 @@ def test_dist_hybrid_topology_2x4():
     assert codes == [0, 0], codes
 
 
+def test_dist_num_dead_node_detects_killed_worker():
+    """Liveness facade (reference include/mxnet/kvstore.h:353
+    get_num_dead_node): rank 2 of 3 crashes without cleanup; the
+    survivors must see num_dead_node() report it (dist_worker_kill.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    codes = launch.launch_local(
+        3, [sys.executable, os.path.join(_REPO, "tests",
+                                         "dist_worker_kill.py")], env=env)
+    assert codes == [0, 0, 0], codes
+
+
 def test_dist_init_failure_is_hard():
     """With the dist env set but an unreachable coordinator, the join must
     raise (at import, where mxnet_tpu auto-joins; or at kvstore creation)

@@ -635,6 +635,11 @@ COVERED_ELSEWHERE = {
     "quantized_act", "_contrib_quantized_act",
     # tested in tests/test_flash_attention.py (kernel + op + vjp)
     "flash_attention", "_contrib_flash_attention",
+    # tested in tests/test_round5_ops.py (reference-oracle checks)
+    "SVMOutput", "svm_output", "IdentityAttachKLSparseReg",
+    "identity_attach_KL_sparse_reg", "linalg_gelqf",
+    "_ravel_multi_index", "ravel_multi_index", "_unravel_index",
+    "unravel_index",
     # tested in tests/test_custom_op.py (imperative/gluon/module paths)
     "Custom", "custom",
     # tested in tests/test_contrib_extras.py (numpy-oracle checks)

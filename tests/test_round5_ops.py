@@ -1,0 +1,237 @@
+"""Round-5 gap closures: SVMOutput, IdentityAttachKLSparseReg,
+ravel/unravel, linalg_gelqf, LibSVMIter, AttrScope/NameManager.
+
+Reference parity targets: src/operator/svm_output.cc,
+src/operator/identity_attach_KL_sparse_reg.cc, src/operator/tensor/
+ravel.cc, src/operator/tensor/la_op.cc:752 (gelqf), src/io/iter_libsvm.cc,
+python/mxnet/attribute.py:27, python/mxnet/name.py:25."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput
+# ---------------------------------------------------------------------------
+
+def _svm_grad_oracle(x, label, margin, reg, use_linear):
+    """Direct transcription of the reference L1_SVM/L2_SVM loops."""
+    dst = onp.zeros_like(x)
+    for y in range(x.shape[0]):
+        k = int(label[y])
+        for c in range(x.shape[1]):
+            if use_linear:
+                if c == k:
+                    dst[y, k] = -float(margin > x[y, k]) * reg
+                else:
+                    dst[y, c] = float(margin > -x[y, c]) * reg
+            else:
+                if c == k:
+                    dst[y, k] = 2 * (margin - x[y, k]) \
+                        if margin > x[y, k] else 0.0
+                    dst[y, k] *= -reg
+                else:
+                    dst[y, c] = -2 * (margin + x[y, c]) \
+                        if margin > -x[y, c] else 0.0
+                    dst[y, c] *= -reg
+    return dst
+
+
+@pytest.mark.parametrize("use_linear", [False, True])
+def test_svm_output_forward_identity_and_grad(use_linear):
+    rs = onp.random.RandomState(0)
+    x = rs.randn(6, 5).astype("float32") * 2
+    label = rs.randint(0, 5, (6,)).astype("float32")
+    margin, reg = 1.0, 0.7
+
+    a = mx.nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        out = mx.nd.SVMOutput(a, mx.nd.array(label), margin=margin,
+                              regularization_coefficient=reg,
+                              use_linear=use_linear)
+        s = out.sum()
+    onp.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)   # identity fwd
+    s.backward()
+    want = _svm_grad_oracle(x, label, margin, reg, use_linear)
+    onp.testing.assert_allclose(a.grad.asnumpy(), want, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_svm_output_symbol_path():
+    data = mx.sym.Variable("data")
+    lab = mx.sym.Variable("label")
+    s = mx.sym.SVMOutput(data=data, label=lab, use_linear=True)
+    out = s.eval(data=mx.nd.ones((2, 3)), label=mx.nd.zeros((2,)))[0]
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((2, 3)))
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg
+# ---------------------------------------------------------------------------
+
+def test_identity_attach_kl_sparse_reg_grad():
+    rs = onp.random.RandomState(1)
+    x = rs.uniform(0.05, 0.95, (8, 4)).astype("float32")
+    rho, penalty, momentum = 0.2, 0.01, 0.9
+    ma0 = onp.full((4,), 0.5, "float32")
+
+    a = mx.nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        out = mx.nd.IdentityAttachKLSparseReg(
+            a, mx.nd.array(ma0), sparseness_target=rho, penalty=penalty,
+            momentum=momentum)
+        s = out.sum()
+    onp.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)
+    s.backward()
+    avg = x.mean(axis=0)
+    ma = momentum * ma0 + (1 - momentum) * avg
+    kl = penalty * (-rho / ma + (1 - rho) / (1 - ma))
+    want = onp.ones_like(x) + kl[None, :]
+    onp.testing.assert_allclose(a.grad.asnumpy(), want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ravel / unravel
+# ---------------------------------------------------------------------------
+
+def test_ravel_unravel_roundtrip_matches_numpy():
+    shape = (3, 4, 5)
+    rs = onp.random.RandomState(2)
+    flat = rs.randint(0, 60, (17,)).astype("int64")
+    multi = onp.stack(onp.unravel_index(flat, shape)).astype("float32")
+
+    got_flat = mx.nd.ravel_multi_index(mx.nd.array(multi), shape=shape)
+    onp.testing.assert_array_equal(got_flat.asnumpy().astype("int64"), flat)
+
+    got_multi = mx.nd.unravel_index(
+        mx.nd.array(flat.astype("float32")), shape=shape)
+    onp.testing.assert_array_equal(got_multi.asnumpy(), multi)
+
+
+# ---------------------------------------------------------------------------
+# linalg_gelqf
+# ---------------------------------------------------------------------------
+
+def test_linalg_gelqf_reconstructs_with_conventions():
+    rs = onp.random.RandomState(3)
+    A = rs.randn(3, 5).astype("float32")
+    Q, L = mx.nd.linalg_gelqf(mx.nd.array(A))
+    Qn, Ln = Q.asnumpy(), L.asnumpy()
+    onp.testing.assert_allclose(Ln @ Qn, A, atol=1e-5)           # A = L Q
+    onp.testing.assert_allclose(Qn @ Qn.T, onp.eye(3), atol=1e-5)
+    assert onp.allclose(Ln, onp.tril(Ln), atol=1e-6)             # lower tri
+    assert (onp.diag(Ln) > 0).all()                              # sign conv
+    # batched
+    Ab = rs.randn(4, 2, 6).astype("float32")
+    Qb, Lb = mx.nd.linalg_gelqf(mx.nd.array(Ab))
+    onp.testing.assert_allclose(
+        onp.einsum("bij,bjk->bik", Lb.asnumpy(), Qb.asnumpy()), Ab,
+        atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# LibSVMIter
+# ---------------------------------------------------------------------------
+
+def test_libsvm_iter_dense_values(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:-2\n"
+                 "0 1:0.5\n"
+                 "2 0:1 1:2 2:3 3:4\n"
+                 "1 2:7\n"
+                 "0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3          # 5 rows, round_batch pads the last
+    d0 = batches[0].data[0].asnumpy()
+    onp.testing.assert_allclose(
+        d0, [[1.5, 0, 0, -2], [0, 0.5, 0, 0]])
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy(), [1, 0])
+    onp.testing.assert_allclose(
+        batches[1].data[0].asnumpy(), [[1, 2, 3, 4], [0, 0, 7, 0]])
+    # empty-feature row decodes to zeros
+    onp.testing.assert_allclose(batches[2].data[0].asnumpy()[0],
+                                [0, 0, 0, 0])
+    it.reset()
+    again = next(iter(it)).data[0].asnumpy()
+    onp.testing.assert_allclose(again, d0)
+
+
+def test_libsvm_iter_separate_label_file(tmp_path):
+    pd = tmp_path / "d.libsvm"
+    pl = tmp_path / "l.libsvm"
+    pd.write_text("0 0:1\n0 1:1\n")
+    pl.write_text("0 0:0.5 1:0.25\n0 1:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(pd), data_shape=(2,),
+                          label_libsvm=str(pl), label_shape=(2,),
+                          batch_size=2)
+    b = next(iter(it))
+    onp.testing.assert_allclose(b.label[0].asnumpy(),
+                                [[0.5, 0.25], [0.0, 1.0]])
+
+
+def test_libsvm_iter_rejects_out_of_range_index(tmp_path):
+    p = tmp_path / "bad.libsvm"
+    p.write_text("1 4:1.0\n")
+    with pytest.raises(ValueError, match="zero-based"):
+        mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=1)
+
+
+# ---------------------------------------------------------------------------
+# AttrScope / NameManager
+# ---------------------------------------------------------------------------
+
+def test_attr_scope_applies_and_nests():
+    with mx.AttrScope(ctx_group="dev1", __lr_mult__="2"):
+        a = mx.sym.Variable("a")
+        with mx.AttrScope(ctx_group="dev2"):
+            fc = mx.sym.FullyConnected(a, num_hidden=3, name="fc")
+    b = mx.sym.Variable("b")
+    assert a.attr("ctx_group") == "dev1"
+    assert a.attr("__lr_mult__") == "2"
+    assert fc.attr("ctx_group") == "dev2"       # inner scope wins
+    assert fc.attr("__lr_mult__") == "2"        # outer attrs inherited
+    assert b.attr("ctx_group") is None          # scope exited
+
+    # metadata must NOT leak into kernel params: the symbol still evals
+    out = fc.eval(a=mx.nd.ones((2, 4)), fc_weight=mx.nd.ones((3, 4)),
+                  fc_bias=mx.nd.zeros((3,)))[0]
+    assert out.shape == (2, 3)
+
+
+def test_attr_scope_survives_json_roundtrip():
+    with mx.AttrScope(ctx_group="dev3"):
+        x = mx.sym.Variable("x")
+        y = mx.sym.Activation(x, act_type="relu", name="act")
+    z = mx.sym.load_json(y.tojson())
+    assert z.attr("ctx_group") == "dev3"
+    assert z.attr("act_type") == "relu"
+    out = z.eval(x=mx.nd.array([[-1.0, 2.0]]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [[0.0, 2.0]])
+
+
+def test_attr_kwarg_merges_over_scope():
+    with mx.AttrScope(ctx_group="dev1", tag="scope"):
+        s = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu",
+                              attr={"tag": "call"})
+    assert s.attr("tag") == "call"
+    assert s.attr("ctx_group") == "dev1"
+
+
+def test_name_manager_and_prefix():
+    with mx.name.NameManager():
+        a = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+        b = mx.sym.Activation(mx.sym.Variable("y"), act_type="relu")
+        assert a.name == "activation0"
+        assert b.name == "activation1"
+        with mx.name.Prefix("net_"):
+            c = mx.sym.Activation(mx.sym.Variable("z"), act_type="relu")
+            assert c.name == "net_activation0"
+        # explicit names pass through untouched
+        d = mx.sym.Activation(mx.sym.Variable("w"), act_type="relu",
+                              name="mine")
+        assert d.name == "mine"

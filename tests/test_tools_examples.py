@@ -198,3 +198,34 @@ def test_dcgan_example_runs():
     d_loss, g_loss, std = float(parts[1]), float(parts[3]), float(parts[5])
     assert onp.isfinite(d_loss) and onp.isfinite(g_loss)
     assert std > 0.02, "generator collapsed to a constant: std=%s" % std
+
+
+def test_bucketing_lm_example():
+    """example/rnn/bucketing_lm: BucketingModule trains a shared-param
+    LSTM LM across 4 length buckets, one compiled program per bucket
+    (reference example/rnn/bucketing + docs/faq/bucketing.md)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", "rnn",
+                                      "bucketing_lm", "train.py"),
+         "--epochs", "8", "--sentences", "300"],
+        env=ENV, capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stderr[-800:]
+    final = [l for l in out.stdout.splitlines() if l.startswith("FINAL_PPL")]
+    # vocab is 32: uniform ppl == 32; the LM must beat it
+    assert final and float(final[0].split()[1]) < 32.0, out.stdout[-500:]
+    assert "buckets compiled: 4" in out.stdout
+
+
+def test_finetune_example_loads_upstream_params():
+    """example/image_classification/finetune.py: upstream-binary .params
+    checkpoint -> feature transfer into a new-head zoo net -> frozen-
+    backbone training (reference fine-tune.py / docs/faq/finetune.md)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example",
+                                      "image_classification",
+                                      "finetune.py")],
+        env=ENV, capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "loaded 100 feature tensors" in out.stdout
+    final = [l for l in out.stdout.splitlines() if l.startswith("FINAL_ACC")]
+    assert final and float(final[0].split()[1]) > 0.8, out.stdout[-500:]
