@@ -437,20 +437,50 @@ def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
     return jax.nn.softmax(data, axis=axis)
 
 
-@jax.custom_vjp
-def _softmax_output_core(data, label):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         normalization, smooth_alpha, batch_size):
     return jax.nn.softmax(data, axis=-1)
 
 
-def _smo_fwd(data, label):
+def _smo_fwd(data, label, grad_scale, ignore_label, use_ignore,
+             normalization, smooth_alpha, batch_size):
     out = jax.nn.softmax(data, axis=-1)
     return out, (out, label)
 
 
-def _smo_bwd(res, g):
+def _smo_bwd(grad_scale, ignore_label, use_ignore, normalization,
+             smooth_alpha, batch_size, res, g):
+    # reference mshadow SoftmaxGrad/SmoothSoftmaxGrad + the normalization
+    # ladder of softmax_output-inl.h:187-242
     out, label = res
-    oh = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1], dtype=out.dtype)
-    return ((out - oh), jnp.zeros_like(label))
+    k = out.shape[-1]
+    li = label.astype(jnp.int32)
+    oh = jax.nn.one_hot(li, k, dtype=out.dtype)
+    if smooth_alpha:
+        # target gets p-1+alpha; the rest p - alpha/(K-1)
+        target = (1.0 - smooth_alpha) * oh \
+            + (smooth_alpha / max(k - 1, 1)) * (1.0 - oh)
+        dx = out - target.astype(out.dtype)
+    else:
+        dx = out - oh
+    valid = None
+    if use_ignore:
+        valid = (label != ignore_label)
+        dx = dx * valid[:, None].astype(dx.dtype)
+    scale = jnp.asarray(grad_scale, jnp.float32)
+    if normalization == "batch":
+        # divide by the TRUE batch size (reference kBatch uses
+        # label.size(0)), not the flattened N*positions row count the
+        # multi_output path hands this kernel
+        scale = scale / batch_size
+    elif normalization == "valid":
+        if valid is None:
+            valid = (label != ignore_label)
+        scale = scale / jnp.maximum(
+            jnp.sum(valid.astype(jnp.float32)), 1.0)
+    dx = (dx.astype(jnp.float32) * scale).astype(out.dtype)
+    return (dx, jnp.zeros_like(label))
 
 
 _softmax_output_core.defvjp(_smo_fwd, _smo_bwd)
@@ -462,16 +492,21 @@ def softmax_output(data, label, grad_scale: float = 1.0, ignore_label: float = -
                    preserve_shape: bool = False, normalization: str = "null",
                    out_grad: bool = False, smooth_alpha: float = 0.0):
     """Reference src/operator/softmax_output-inl.h: forward = softmax; the
-    *backward* ignores the incoming head-grad and produces (p - onehot) —
-    implemented via custom_vjp (scaled by grad_scale)."""
+    *backward* ignores the incoming head-grad and produces (p - target)
+    via custom_vjp, honoring grad_scale, use_ignore/ignore_label,
+    normalization ('null'|'batch'|'valid') and smooth_alpha label
+    smoothing (mshadow SoftmaxGrad/SmoothSoftmaxGrad)."""
+    knobs = (float(grad_scale), float(ignore_label), bool(use_ignore),
+             str(normalization), float(smooth_alpha), int(data.shape[0]))
     if data.ndim > 2 and multi_output:
         # (N, C, ...) softmax over C with per-position labels
         x = jnp.moveaxis(data, 1, -1)
-        out = _softmax_output_core(x, label.reshape(x.shape[:-1]))
-        out = jnp.moveaxis(out, -1, 1)
+        flat = x.reshape(-1, x.shape[-1])
+        out = _softmax_output_core(flat, label.reshape(-1), *knobs)
+        out = jnp.moveaxis(out.reshape(x.shape), -1, 1)
         return out
     x = data.reshape(data.shape[0], -1)
-    out = _softmax_output_core(x, label.reshape(-1))
+    out = _softmax_output_core(x, label.reshape(-1), *knobs)
     return out.reshape(data.shape) if preserve_shape else out
 
 

@@ -235,3 +235,85 @@ def test_name_manager_and_prefix():
         d = mx.sym.Activation(mx.sym.Variable("w"), act_type="relu",
                               name="mine")
         assert d.name == "mine"
+
+
+# ---------------------------------------------------------------------------
+# SoftmaxOutput knobs (grad_scale / ignore / normalization / smoothing)
+# ---------------------------------------------------------------------------
+
+def _smo_grad(x, label, **kw):
+    a = mx.nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        out = mx.nd.SoftmaxOutput(a, mx.nd.array(label), **kw)
+        out.sum().backward()
+    return a.grad.asnumpy(), out.asnumpy()
+
+
+def test_softmax_output_grad_scale_and_batch_norm():
+    rs = onp.random.RandomState(4)
+    x = rs.randn(6, 5).astype("float32")
+    label = rs.randint(0, 5, (6,)).astype("float32")
+    g1, p = _smo_grad(x, label)
+    oh = onp.eye(5, dtype="float32")[label.astype(int)]
+    onp.testing.assert_allclose(g1, p - oh, rtol=1e-5, atol=1e-6)
+    g2, _ = _smo_grad(x, label, grad_scale=0.5)
+    onp.testing.assert_allclose(g2, 0.5 * g1, rtol=1e-5, atol=1e-6)
+    g3, _ = _smo_grad(x, label, normalization="batch")
+    onp.testing.assert_allclose(g3, g1 / 6.0, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_output_ignore_and_valid_norm():
+    rs = onp.random.RandomState(5)
+    x = rs.randn(6, 4).astype("float32")
+    label = onp.array([0, 1, -1, 2, -1, 3], "float32")
+    g, p = _smo_grad(x, label, use_ignore=True, ignore_label=-1)
+    onp.testing.assert_allclose(g[2], 0.0)          # ignored rows: zero
+    onp.testing.assert_allclose(g[4], 0.0)
+    oh = onp.zeros((6, 4), "float32")
+    for i, l in enumerate(label):
+        if l >= 0:
+            oh[i, int(l)] = 1
+    want = p - oh
+    want[[2, 4]] = 0
+    onp.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-6)
+    gv, _ = _smo_grad(x, label, use_ignore=True, ignore_label=-1,
+                      normalization="valid")
+    onp.testing.assert_allclose(gv, want / 4.0, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_output_label_smoothing():
+    rs = onp.random.RandomState(6)
+    x = rs.randn(3, 5).astype("float32")
+    label = onp.array([1, 0, 4], "float32")
+    alpha = 0.2
+    g, p = _smo_grad(x, label, smooth_alpha=alpha)
+    want = p.copy()
+    for i, l in enumerate(label):
+        for c in range(5):
+            if c == int(l):
+                want[i, c] = p[i, c] - 1.0 + alpha
+            else:
+                want[i, c] = p[i, c] - alpha / 4.0
+    onp.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_output_multi_output_batch_norm_divides_by_batch():
+    """multi_output + normalization='batch' divides by the TRUE batch
+    size N (reference kBatch uses label.size(0)), not N*positions."""
+    rs = onp.random.RandomState(7)
+    x = rs.randn(2, 3, 4).astype("float32")      # (N=2, C=3, pos=4)
+    label = rs.randint(0, 3, (2, 4)).astype("float32")
+    a = mx.nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        out = mx.nd.SoftmaxOutput(a, mx.nd.array(label), multi_output=True,
+                                  normalization="batch")
+        out.sum().backward()
+    p = out.asnumpy()
+    want = p.copy()
+    for n in range(2):
+        for pos in range(4):
+            want[n, int(label[n, pos]), pos] -= 1.0
+    onp.testing.assert_allclose(a.grad.asnumpy(), want / 2.0,
+                                rtol=1e-5, atol=1e-6)

@@ -229,3 +229,35 @@ def test_finetune_example_loads_upstream_params():
     assert "loaded 100 feature tensors" in out.stdout
     final = [l for l in out.stdout.splitlines() if l.startswith("FINAL_ACC")]
     assert final and float(final[0].split()[1]) > 0.8, out.stdout[-500:]
+
+
+def test_rec2idx_rebuilds_index(tmp_path):
+    """tools/rec2idx.py: regenerated .idx must bit-match the one the
+    writer produced (reference tools/rec2idx.py IndexCreator)."""
+    import numpy as onp
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rs = onp.random.RandomState(0)
+    for i in range(9):
+        hdr = recordio.IRHeader(0, float(i), i * 7, 0)  # non-trivial ids
+        w.write_idx(i * 7, recordio.pack(hdr, rs.bytes(50 + i * 13)))
+    w.close()
+    original = open(idx).read()
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import rec2idx
+    out_idx = str(tmp_path / "rebuilt.idx")
+    rec2idx.main([rec, out_idx])
+    assert open(out_idx).read() == original
+
+
+def test_flakiness_checker_runs_trials():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "flakiness_checker.py"),
+         "tests/test_ndarray.py::test_creation", "-n", "2"],
+        env=ENV, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout[-600:] + out.stderr[-400:]
+    assert "0/2 trials failed" in out.stdout

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Run a test many times to estimate flakiness (reference
+``tools/flakiness_checker.py``): same CLI shape —
+``python tools/flakiness_checker.py test_module.test_name [-n trials]``.
+
+Each trial runs under a fresh random seed (MXNET_TEST_SEED, honored by
+the suite's seeded fixtures) in a fresh interpreter, so state cannot
+leak between trials.  Exits nonzero if any trial fails.
+"""
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spec_to_pytest(spec):
+    """'test_module.test_name' or 'path/to/test.py::name' -> pytest id."""
+    if "::" in spec or spec.endswith(".py"):
+        return spec
+    if "." in spec:
+        mod, name = spec.rsplit(".", 1)
+        return os.path.join("tests", mod.replace(".", os.sep) + ".py") \
+            + "::" + name
+    return os.path.join("tests", spec + ".py")   # bare module name
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="test spec: test_module.test_name or a "
+                                 "pytest id (file.py::name)")
+    ap.add_argument("-n", "--num-trials", type=int, default=10)
+    ap.add_argument("-s", "--seed", type=int, default=None,
+                    help="fixed base seed (default: random per trial)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    target = spec_to_pytest(args.test)
+    failures = 0
+    for trial in range(args.num_trials):
+        seed = args.seed if args.seed is not None \
+            else random.randint(0, 2 ** 31 - 1)
+        env = dict(os.environ, MXNET_TEST_SEED=str(seed),
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", target, "-x", "-q"],
+            cwd=REPO, env=env, capture_output=not args.verbose)
+        ok = res.returncode == 0
+        failures += 0 if ok else 1
+        print("trial %d/%d seed=%d: %s"
+              % (trial + 1, args.num_trials, seed,
+                 "PASS" if ok else "FAIL"), flush=True)
+        if not ok and not args.verbose and res.stdout:
+            sys.stdout.write(res.stdout.decode()[-1500:])
+    print("flakiness: %d/%d trials failed" % (failures, args.num_trials))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Rebuild the ``.idx`` file for a ``.rec`` (reference ``tools/rec2idx.py``
+IndexCreator): scans the RecordIO framing, recovers each record's id from
+its IRHeader, and writes ``id \\t byte-offset`` lines.
+
+Uses the native mmap scanner when the C++ layer is built; falls back to
+the pure-Python reader.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx path (default: alongside the .rec)")
+    ap.add_argument("--sequential", action="store_true",
+                    help="number records 0..n-1 instead of reading the "
+                         "packed IRHeader id")
+    args = ap.parse_args(argv)
+    idx_path = args.index or os.path.splitext(args.record)[0] + ".idx"
+
+    # ONE pass with the canonical reader — tell() before each read() is
+    # the record's byte offset, and the payload carries its IRHeader id
+    # (so framing knowledge stays in recordio.py alone)
+    reader = recordio.MXRecordIO(args.record, "r")
+    n = 0
+    try:
+        with open(idx_path, "w") as out:
+            while True:
+                off = reader.tell()
+                payload = reader.read()
+                if payload is None:
+                    break
+                if args.sequential:
+                    key = n
+                else:
+                    header, _ = recordio.unpack(payload)
+                    key = int(header.id)
+                out.write("%d\t%d\n" % (key, off))
+                n += 1
+    finally:
+        reader.close()
+    print("wrote %d entries to %s" % (n, idx_path))
+    return idx_path
+
+
+if __name__ == "__main__":
+    main()
