@@ -262,11 +262,48 @@ class Executor:
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
-        """Re-bind with new input shapes (reference graph_executor Reshape);
-        the jitted graph fn is shared with the new executor, so switching
-        back to a previously-seen shape hits the existing compile cache."""
+        """Re-bind with new input shapes (reference graph_executor Reshape /
+        executor.py:1076): the jitted graph fn is shared with the new
+        executor, so switching back to a previously-seen shape hits the
+        existing compile cache.
+
+        Contract parity with the reference:
+          * an UNSPECIFIED argument whose inferred shape changes raises
+            unless ``partial_shaping`` — silent parameter reallocation is
+            the bug class this flag guards;
+          * a larger new array raises unless ``allow_up_sizing`` (the
+            reference reuses the bound memory in place, so growing needs
+            the explicit opt-in; here it allocates fresh zeros).
+        Unchanged arguments share the SAME NDArrays, and size-preserving
+        (or shrinking) changes VIEW the existing values — the reference's
+        shared-memory-pool semantics: trained weights persist across
+        bucket switches; only genuine up-sizing allocates fresh zeros.
+        """
         from . import ndarray as nd
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+
+        def remake(name, shape, cur, specified):
+            new_size = int(onp.prod(shape)) if shape else 1
+            cur_size = int(onp.prod(cur.shape)) if cur.shape else 1
+            if not specified and not partial_shaping:
+                raise MXNetError(
+                    "Executor.reshape: shape of unspecified argument %r "
+                    "changed %s -> %s; pass partial_shaping=True to allow"
+                    % (name, tuple(cur.shape), shape))
+            if new_size > cur_size:
+                if not allow_up_sizing:
+                    raise MXNetError(
+                        "Executor.reshape: argument %r grows %s -> %s; "
+                        "pass allow_up_sizing=True to allocate a larger "
+                        "array" % (name, tuple(cur.shape), shape))
+                return nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+            # size-preserving / shrinking: reinterpret the existing
+            # values like the reference's in-place view
+            flat = cur.reshape((cur_size,))
+            if new_size < cur_size:
+                flat = flat[:new_size]
+            return flat.reshape(shape)
+
         args, grads = [], []
         for name, shape, cur, grad in zip(self._arg_names, arg_shapes,
                                           self.arg_arrays, self.grad_arrays):
@@ -274,12 +311,13 @@ class Executor:
                 args.append(cur)
                 grads.append(grad)
             else:
-                args.append(nd.zeros(shape, ctx=self._ctx,
-                                     dtype=cur.dtype))
+                args.append(remake(name, shape, cur, name in kwargs))
                 grads.append(nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
                              if grad is not None else None)
         aux = [cur if tuple(cur.shape) == shape
-               else nd.zeros(shape, ctx=self._ctx, dtype=cur.dtype)
-               for shape, cur in zip(aux_shapes, self.aux_arrays)]
+               else remake(name, shape, cur, True)
+               for (shape, cur, name) in zip(
+                   aux_shapes, self.aux_arrays,
+                   self._symbol.list_auxiliary_states())]
         return Executor(self._symbol, self._ctx, args, grads,
                         self._grad_req, aux, _shared_jit=self._jit_fwd)

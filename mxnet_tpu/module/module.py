@@ -293,7 +293,11 @@ class Module(BaseModule):
         cur_shapes = {n: tuple(self._exec.arg_dict[n].shape)
                       for n in feeds}
         if new_shapes != cur_shapes:
-            self._exec = self._exec.reshape(**new_shapes)
+            # the reference exec_group reshapes with allow_up_sizing=True
+            # (executor_group.py bind_exec reshape path); weights keep
+            # their shapes so partial_shaping stays strict
+            self._exec = self._exec.reshape(allow_up_sizing=True,
+                                            **new_shapes)
         if self._mesh is not None:
             self._feed_sharded(feeds)
             self._exec.forward(is_train=is_train)

@@ -475,10 +475,13 @@ def _smo_bwd(grad_scale, ignore_label, use_ignore, normalization,
         # multi_output path hands this kernel
         scale = scale / batch_size
     elif normalization == "valid":
-        if valid is None:
-            valid = (label != ignore_label)
-        scale = scale / jnp.maximum(
-            jnp.sum(valid.astype(jnp.float32)), 1.0)
+        # reference kValid: non-ignored count under use_ignore, else the
+        # full label count (softmax_output-inl.h:194)
+        if valid is not None:
+            scale = scale / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        else:
+            scale = scale / max(int(label.shape[0]), 1)
     dx = (dx.astype(jnp.float32) * scale).astype(out.dtype)
     return (dx, jnp.zeros_like(label))
 

@@ -182,3 +182,45 @@ def test_sym_contrib_namespace():
     d = sym.contrib.div_sqrt_dim(sym.var("x"))
     got = d.eval_imperative({"x": mx.nd.ones((2, 16))}).asnumpy()
     onp.testing.assert_allclose(got, onp.full((2, 16), 0.25), rtol=1e-6)
+
+
+def test_executor_reshape_contract():
+    """Executor.reshape parity (reference executor.py:1076 Reshape):
+    strict partial_shaping/allow_up_sizing flags, weight sharing across
+    reshaped executors (the shared-memory-pool semantics)."""
+    import pytest
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    ex = fc.simple_bind(ctx=mx.cpu(), data=(4, 5))
+    ex.arg_dict["fc_weight"][:] = mx.nd.ones((3, 5))
+    ex.arg_dict["fc_bias"][:] = mx.nd.full((3,), 2.0)
+
+    # batch-size change: down-sizing, weights unchanged -> allowed and
+    # the SAME weight NDArrays are shared (trained values persist)
+    ex2 = ex.reshape(data=(2, 5))
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    out = ex2.forward(is_train=False, data=mx.nd.ones((2, 5)))[0]
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 7.0),
+                                rtol=1e-6)
+
+    # up-sizing the specified input needs the explicit opt-in
+    with pytest.raises(mx.MXNetError, match="allow_up_sizing"):
+        ex.reshape(data=(8, 5))
+    ex3 = ex.reshape(allow_up_sizing=True, data=(8, 5))
+    assert ex3.arg_dict["data"].shape == (8, 5)
+    assert ex3.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+
+    # a feature-dim change would silently reallocate the weight: strict
+    # mode refuses, partial_shaping=True (with up-sizing) permits
+    with pytest.raises(mx.MXNetError, match="partial_shaping"):
+        ex.reshape(data=(2, 9))      # weight (3,9) changes unrequested
+    ex4 = ex.reshape(partial_shaping=True, allow_up_sizing=True,
+                     data=(2, 9))
+    assert ex4.arg_dict["fc_weight"].shape == (3, 9)
+
+    # switching BACK to the original shape reuses the shared jit (smoke:
+    # runs and produces the original-shape output)
+    ex5 = ex3.reshape(data=(4, 5))
+    out5 = ex5.forward(is_train=False, data=mx.nd.ones((4, 5)))[0]
+    onp.testing.assert_allclose(out5.asnumpy(), onp.full((4, 3), 7.0),
+                                rtol=1e-6)

@@ -1,10 +1,8 @@
 #!/usr/bin/env python
 """Rebuild the ``.idx`` file for a ``.rec`` (reference ``tools/rec2idx.py``
-IndexCreator): scans the RecordIO framing, recovers each record's id from
-its IRHeader, and writes ``id \\t byte-offset`` lines.
-
-Uses the native mmap scanner when the C++ layer is built; falls back to
-the pure-Python reader.
+IndexCreator): one pass with the canonical ``MXRecordIO`` reader —
+``tell()`` before each ``read()`` is the record's byte offset, and the
+payload's IRHeader carries its id; ``id \\t byte-offset`` lines out.
 """
 import argparse
 import os
