@@ -736,6 +736,8 @@ def main():
                                             iters=max(1, args.iters // 4)))
         jobs.append(lambda: bench_bert(iters=args.iters))
         jobs.append(lambda: bench_ssd(iters=max(4, args.iters // 3)))
+        jobs.append(lambda: bench_ssd(batch_size=16, image_size=224,
+                                      iters=max(4, args.iters // 3)))
         jobs.append(bench_input_pipeline_isolated)
     else:
         # the default run covers every BASELINE.json config (the driver
@@ -775,8 +777,12 @@ def main():
         jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
                                             iters=max(2, it // 4)))
         jobs.append(lambda: bench_bert(iters=max(6, it // 2)))
-        # detection train step (device-side MultiBoxTarget, no callbacks)
+        # detection train step (device-side MultiBoxTarget, no callbacks):
+        # the 128px smoke config plus an SSD300-scale capability config
+        # (224px -> 16.5k anchors, ~1.9x real SSD300's 8732)
         jobs.append(lambda: bench_ssd(iters=max(4, it // 3)))
+        jobs.append(lambda: bench_ssd(batch_size=16, image_size=224,
+                                      iters=max(4, it // 3)))
         # input pipeline (rec -> host -> device -> step legs) — in a FRESH
         # subprocess: after ~14 jobs this process's accumulated jax
         # runtime threads strangle the 1-core decode pool (measured 84
@@ -850,7 +856,7 @@ def main():
 
 def _train_key(d):
     return (d.get("bench"), d.get("model"), d.get("batch_size"),
-            d.get("dtype"), d.get("mirror") or None)
+            d.get("dtype"), d.get("mirror") or None, d.get("image_size"))
 
 
 def _sanity_gates(details):
