@@ -45,38 +45,38 @@ class BatchSampler(Sampler):
     """Wrap a sampler into batches with keep/discard/rollover last-batch
     policies (reference sampler.py:74)."""
 
+    _POLICIES = ("keep", "discard", "rollover")
+
     def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in self._POLICIES:
+            raise ValueError(
+                "last_batch must be one of 'keep', 'discard', or "
+                "'rollover', but got %s" % last_batch)
         self._sampler = sampler
-        self._batch_size = batch_size
-        self._last_batch = last_batch
+        self._batch_size, self._last_batch = batch_size, last_batch
         self._prev = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
-            if len(batch) == self._batch_size:
-                yield batch
-                batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                return
-            elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(
-                    "last_batch must be one of 'keep', 'discard', or 'rollover', "
-                    "but got %s" % self._last_batch)
+        # rolled-over leftovers from the previous epoch seed this one
+        pending = self._prev
+        self._prev = []
+        for idx in self._sampler:
+            pending.append(idx)
+            if len(pending) >= self._batch_size:
+                yield pending[:self._batch_size]
+                pending = pending[self._batch_size:]
+        if not pending:
+            return
+        if self._last_batch == "keep":
+            yield pending
+        elif self._last_batch == "rollover":
+            self._prev = pending
+        # 'discard': drop the partial batch
 
     def __len__(self):
         if self._last_batch == "keep":
             return (len(self._sampler) + self._batch_size - 1) // self._batch_size
         if self._last_batch == "discard":
             return len(self._sampler) // self._batch_size
-        if self._last_batch == "rollover":
-            return (len(self._prev) + len(self._sampler)) // self._batch_size
-        raise ValueError(
-            "last_batch must be one of 'keep', 'discard', or 'rollover', "
-            "but got %s" % self._last_batch)
+        # _POLICIES is validated at construction: rollover is the last case
+        return (len(self._prev) + len(self._sampler)) // self._batch_size
