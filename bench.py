@@ -473,7 +473,7 @@ def bench_input_pipeline_isolated():
 
 
 def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
-               arch="base", padded=True):
+               arch="base", padded=True, pipelined_k=0):
     """BERT pretraining-style train step (BASELINE.json config 5): MLM loss
     over a bert_base encoder whose attention runs in the Pallas flash
     kernel; fwd+loss+bwd+Adam as one donated XLA program.
@@ -529,13 +529,37 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
     else:
         run = lambda: step(tokens, labels)
     # the first few calls recompile as donation settles buffer layouts
-    step_s, loss, _ = _time_calls(run, _sync, warmup=4, iters=iters)
-    return {"bench": "bert_mlm_train", "arch": arch,
-            "batch_size": batch_size, "seq_len": seq_len, "dtype": dtype,
-            "padded": padded,
-            "step_ms": round(step_s * 1000, 2),
-            "tokens_per_sec": round(batch_size * seq_len / step_s, 1),
-            "loss": round(_sync(loss), 3)}
+    step_s, loss, timing = _time_calls(run, _sync, warmup=4, iters=iters)
+    out = {"bench": "bert_mlm_train", "arch": arch,
+           "batch_size": batch_size, "seq_len": seq_len, "dtype": dtype,
+           "padded": padded,
+           "step_ms": round(step_s * 1000, 2),
+           "tokens_per_sec": round(batch_size * seq_len / step_s, 1),
+           "loss": round(_sync(loss), 3), "timing": timing}
+    if pipelined_k and not padded:
+        raise ValueError("bench_bert pipelined_k requires padded=True "
+                         "(the scan stacks per-row valid lengths)")
+    if pipelined_k:
+        # k steps per dispatch (scan_steps over stacked token batches)
+        K = pipelined_k
+        tk = mx.nd.array(
+            rs.randint(0, vocab, (K, batch_size, seq_len)).astype("float32"),
+            ctx=mx.tpu())
+        lk = mx.nd.array(
+            rs.randint(0, vocab, (K, batch_size, seq_len)).astype("float32"),
+            ctx=mx.tpu())
+        vk = mx.nd.array(
+            onp.tile(host_vl.asnumpy(), (K, 1)).astype("int32"),
+            ctx=mx.tpu(), dtype="int32")
+        scan_s, _, scan_timing = _time_calls(
+            lambda: step.scan_steps((tk, None, None, vk), lk), _sync,
+            warmup=2, iters=max(2, iters // 3))
+        out["pipelined_k"] = K
+        out["pipelined_step_ms"] = round(scan_s * 1000 / K, 2)
+        out["tokens_per_sec_pipelined"] = round(
+            K * batch_size * seq_len / scan_s, 1)
+        out["pipelined_timing"] = scan_timing
+    return out
 
 
 def bench_ssd(batch_size=32, image_size=128, iters=8):
@@ -734,7 +758,7 @@ def main():
         jobs.append(lambda: bench_attention(iters=max(1, args.iters // 4)))
         jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
                                             iters=max(1, args.iters // 4)))
-        jobs.append(lambda: bench_bert(iters=args.iters))
+        jobs.append(lambda: bench_bert(iters=args.iters, pipelined_k=4))
         jobs.append(lambda: bench_ssd(iters=max(4, args.iters // 3)))
         jobs.append(lambda: bench_ssd(batch_size=16, image_size=224,
                                       iters=max(4, args.iters // 3)))
@@ -776,7 +800,8 @@ def main():
         jobs.append(lambda: bench_attention(iters=max(2, it // 4)))
         jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
                                             iters=max(2, it // 4)))
-        jobs.append(lambda: bench_bert(iters=max(6, it // 2)))
+        jobs.append(lambda: bench_bert(iters=max(6, it // 2),
+                                       pipelined_k=4))
         # detection train step (device-side MultiBoxTarget, no callbacks):
         # the 128px smoke config plus an SSD300-scale capability config
         # (224px -> 16.5k anchors, ~1.9x real SSD300's 8732)
