@@ -483,6 +483,9 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
     softmax, so this measures the masked fused path, not a mask-free
     idealization.  tokens_per_sec counts all (padded) positions, matching
     how the reference reports throughput."""
+    if pipelined_k and not padded:
+        raise ValueError("bench_bert pipelined_k requires padded=True "
+                         "(the scan stacks per-row valid lengths)")
     import numpy as onp
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
@@ -536,9 +539,6 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
            "step_ms": round(step_s * 1000, 2),
            "tokens_per_sec": round(batch_size * seq_len / step_s, 1),
            "loss": round(_sync(loss), 3), "timing": timing}
-    if pipelined_k and not padded:
-        raise ValueError("bench_bert pipelined_k requires padded=True "
-                         "(the scan stacks per-row valid lengths)")
     if pipelined_k:
         # k steps per dispatch (scan_steps over stacked token batches)
         K = pipelined_k

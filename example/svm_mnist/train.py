@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""SVM digit classification with ``SVMOutput`` (reference
+``example/svm_mnist/svm_mnist.py``).
+
+The reference swaps a softmax head for ``SVMOutput`` — forward is
+identity, backward injects the multiclass hinge-loss gradient (L2-SVM by
+default, ``use_linear`` for L1) — and trains a small MLP on MNIST.  This
+build registers the same op (``ops/nn.py`` SVMOutput, ref
+``src/operator/svm_output.cc``); here it trains on synthetic blob digits
+so it runs with zero egress, via the Module API end to end.
+
+    python example/svm_mnist/train.py
+    python example/svm_mnist/train.py --l1  # linear hinge
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+
+def build_sym(num_classes, use_linear):
+    d = sym.var("data")
+    x = sym.FullyConnected(data=d, num_hidden=64, name="fc1")
+    x = sym.Activation(data=x, act_type="relu", name="relu1")
+    x = sym.FullyConnected(data=x, num_hidden=num_classes, name="fc2")
+    return sym.SVMOutput(data=x, name="svm", margin=1.0,
+                         regularization_coefficient=1.0,
+                         use_linear=use_linear)
+
+
+def synthetic_digits(rs, n, num_classes):
+    """Blob-per-class 8x8 images (stands in for MNIST: zero egress)."""
+    X = rs.rand(n, 64).astype("float32") * 0.3
+    Y = rs.randint(0, num_classes, n)
+    for i, k in enumerate(Y):
+        X[i, int(k) * 6:int(k) * 6 + 6] += 1.0
+    return X, Y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--l1", action="store_true", help="linear (L1) hinge")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = onp.random.RandomState(args.seed)
+
+    X, Y = synthetic_digits(rs, 1024, args.num_classes)
+    Xv, Yv = synthetic_digits(onp.random.RandomState(args.seed + 1), 256,
+                              args.num_classes)
+    train = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True,
+                              label_name="svm_label")
+    val = mx.io.NDArrayIter(Xv, Yv, batch_size=args.batch_size,
+                            label_name="svm_label")
+
+    mod = mx.mod.Module(build_sym(args.num_classes, args.l1),
+                        context=mx.cpu(), label_names=["svm_label"])
+    mod.fit(train, eval_data=val, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "rescale_grad": 1.0 / args.batch_size},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Accuracy())
+    acc = mod.score(val, mx.metric.Accuracy())[0][1]
+    logging.info("final validation accuracy: %.3f", acc)
+
+
+if __name__ == "__main__":
+    main()

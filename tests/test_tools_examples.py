@@ -1,6 +1,7 @@
 """Tools + example scripts (reference: tools/ and
 example/image-classification/ are exercised by CI scripts)."""
 import os
+import re
 import subprocess
 import sys
 
@@ -261,3 +262,69 @@ def test_flakiness_checker_runs_trials():
         env=ENV, capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, out.stdout[-600:] + out.stderr[-400:]
     assert "0/2 trials failed" in out.stdout
+
+
+def test_sparse_linear_classification_example():
+    """Row-sparse logistic regression over LibSVMIter data descends
+    (reference example/sparse/linear_classification)."""
+    script = os.path.join(REPO, "example", "sparse",
+                          "linear_classification", "train.py")
+    res = subprocess.run(
+        [sys.executable, script, "--epochs", "4", "--num-features", "200"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    txt = res.stderr + res.stdout
+    assert "final train accuracy" in txt
+    m = re.search(r"loss ([0-9.]+) -> ([0-9.]+)", txt)
+    assert m and float(m.group(2)) < float(m.group(1)), txt[-500:]
+
+
+def test_sparse_matrix_factorization_example():
+    """sparse_grad embedding MF descends (reference
+    example/sparse/matrix_factorization)."""
+    script = os.path.join(REPO, "example", "sparse",
+                          "matrix_factorization", "train.py")
+    res = subprocess.run(
+        [sys.executable, script, "--epochs", "4", "--num-obs", "2048"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    txt = res.stderr + res.stdout
+    m = re.search(r"loss ([0-9.]+) -> ([0-9.]+)", txt)
+    assert m and float(m.group(2)) < float(m.group(1)), txt[-500:]
+
+
+def test_svm_mnist_example():
+    """SVMOutput-head MLP trains to high accuracy on separable blobs
+    (reference example/svm_mnist)."""
+    script = os.path.join(REPO, "example", "svm_mnist", "train.py")
+    res = subprocess.run(
+        [sys.executable, script, "--epochs", "5"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    txt = res.stderr + res.stdout
+    m = re.search(r"final validation accuracy: ([0-9.]+)", txt)
+    assert m and float(m.group(1)) > 0.9, txt[-500:]
+
+
+def test_profiler_example_writes_trace():
+    """Profiler flow (set_config/run/stop/dump) produces xplane artifacts
+    (reference example/profiler)."""
+    script = os.path.join(REPO, "example", "profiler", "profiler_demo.py")
+    res = subprocess.run(
+        [sys.executable, script, "--steps", "3"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "trace written to" in res.stderr + res.stdout
+
+
+def test_bandwidth_probe_measures_links():
+    """tools/bandwidth.py reports h2d/d2h/copy and an 8-device allreduce
+    rate (reference tools/bandwidth/measure.py capability)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bandwidth
+    rows = bandwidth.main(["--sizes-mb", "1", "--iters", "2"])
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["devices"] == 8
+    for k in ("h2d_gbs", "d2h_gbs", "copy_gbs", "allreduce_gbs"):
+        assert r[k] > 0, (k, r)
