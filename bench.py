@@ -473,7 +473,7 @@ def bench_input_pipeline_isolated():
 
 
 def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
-               arch="base", padded=True, pipelined_k=0):
+               arch="base", padded=True, pipelined_k=0, head="masked"):
     """BERT pretraining-style train step (BASELINE.json config 5): MLM loss
     over a bert_base encoder whose attention runs in the Pallas flash
     kernel; fwd+loss+bwd+Adam as one donated XLA program.
@@ -482,10 +482,20 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
     batch shape) — the padding mask runs INSIDE the flash kernel's online
     softmax, so this measures the masked fused path, not a mask-free
     idealization.  tokens_per_sec counts all (padded) positions, matching
-    how the reference reports throughput."""
+    how the reference reports throughput.
+
+    ``head="masked"`` (the default, and the reference pretraining shape:
+    GluonNLP's BERTModel decodes only ``masked_positions``) gathers the
+    standard 15% of positions before the vocab projection, so the MLM
+    head costs B*P rows instead of B*S.  ``head="full"`` decodes every
+    position — profiling showed the full-decode softmax/CE over
+    (B*S, 30522) was ~45% of the step's device time, all of it work the
+    reference pipeline never does."""
     if pipelined_k and not padded:
         raise ValueError("bench_bert pipelined_k requires padded=True "
                          "(the scan stacks per-row valid lengths)")
+    if head not in ("masked", "full"):
+        raise ValueError("head must be 'masked' or 'full', got %r" % head)
     import numpy as onp
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
@@ -505,15 +515,29 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
         lens = rs.randint(seq_len // 3, seq_len + 1, (batch_size,))
         lens[: max(1, batch_size // 4)] = seq_len
         host_vl = mx.nd.array(lens.astype("int32"), dtype="int32")
-        net(host_tokens, None, None, host_vl)  # materialize deferred shapes
+    n_pred = max(1, int(seq_len * 0.15))
+    host_pos = None
+    if head == "masked":
+        # standard MLM: 15% of positions per row, all within the valid
+        # length (min vl = seq_len//3 > n_pred at every benched seq_len)
+        min_vl = int(lens.min()) if padded else seq_len
+        pos = onp.stack([rs.choice(min_vl, n_pred, replace=False)
+                         for _ in range(batch_size)])
+        host_pos = mx.nd.array(onp.sort(pos, 1).astype("int32"),
+                               dtype="int32")
+    if padded:
+        net(host_tokens, None, None, host_vl, host_pos)  # deferred shapes
     else:
-        net(host_tokens)
+        net(host_tokens, None, None, None, host_pos)
     if dtype != "float32":
         net.cast(dtype)
     net.collect_params().reset_ctx(mx.tpu())
     tokens = mx.nd.array(host_tokens.asnumpy(), ctx=mx.tpu())
-    labels = mx.nd.array(rs.randint(0, vocab, (batch_size, seq_len))
+    n_lab = n_pred if head == "masked" else seq_len
+    labels = mx.nd.array(rs.randint(0, vocab, (batch_size, n_lab))
                          .astype("float32"), ctx=mx.tpu())
+    pos = mx.nd.array(host_pos.asnumpy(), ctx=mx.tpu(),
+                      dtype="int32") if head == "masked" else None
 
     class MLMLoss(gluon.loss.Loss):
         def __init__(self):
@@ -526,19 +550,22 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
 
     step = mx.parallel.DataParallelStep(
         net, MLMLoss(), mx.optimizer.Adam(learning_rate=1e-4), mesh=None)
-    if padded:
-        vl = mx.nd.array(host_vl.asnumpy(), ctx=mx.tpu(), dtype="int32")
-        run = lambda: step((tokens, None, None, vl), labels)
+    vl = mx.nd.array(host_vl.asnumpy(), ctx=mx.tpu(),
+                     dtype="int32") if padded else None
+    if padded or head == "masked":
+        run = lambda: step((tokens, None, None, vl, pos), labels)
     else:
         run = lambda: step(tokens, labels)
     # the first few calls recompile as donation settles buffer layouts
     step_s, loss, timing = _time_calls(run, _sync, warmup=4, iters=iters)
     out = {"bench": "bert_mlm_train", "arch": arch,
            "batch_size": batch_size, "seq_len": seq_len, "dtype": dtype,
-           "padded": padded,
+           "padded": padded, "head": head,
            "step_ms": round(step_s * 1000, 2),
            "tokens_per_sec": round(batch_size * seq_len / step_s, 1),
            "loss": round(_sync(loss), 3), "timing": timing}
+    if head == "masked":
+        out["masked_positions"] = n_pred
     if pipelined_k:
         # k steps per dispatch (scan_steps over stacked token batches)
         K = pipelined_k
@@ -546,13 +573,16 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
             rs.randint(0, vocab, (K, batch_size, seq_len)).astype("float32"),
             ctx=mx.tpu())
         lk = mx.nd.array(
-            rs.randint(0, vocab, (K, batch_size, seq_len)).astype("float32"),
+            rs.randint(0, vocab, (K, batch_size, n_lab)).astype("float32"),
             ctx=mx.tpu())
         vk = mx.nd.array(
             onp.tile(host_vl.asnumpy(), (K, 1)).astype("int32"),
             ctx=mx.tpu(), dtype="int32")
+        pk = mx.nd.array(
+            onp.tile(host_pos.asnumpy(), (K, 1, 1)).astype("int32"),
+            ctx=mx.tpu(), dtype="int32") if head == "masked" else None
         scan_s, _, scan_timing = _time_calls(
-            lambda: step.scan_steps((tk, None, None, vk), lk), _sync,
+            lambda: step.scan_steps((tk, None, None, vk, pk), lk), _sync,
             warmup=2, iters=max(2, iters // 3))
         out["pipelined_k"] = K
         out["pipelined_step_ms"] = round(scan_s * 1000 / K, 2)
@@ -759,6 +789,8 @@ def main():
         jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
                                             iters=max(1, args.iters // 4)))
         jobs.append(lambda: bench_bert(iters=args.iters, pipelined_k=4))
+        jobs.append(lambda: bench_bert(iters=max(2, args.iters // 2),
+                                       head="full"))
         jobs.append(lambda: bench_ssd(iters=max(4, args.iters // 3)))
         jobs.append(lambda: bench_ssd(batch_size=16, image_size=224,
                                       iters=max(4, args.iters // 3)))
@@ -800,8 +832,13 @@ def main():
         jobs.append(lambda: bench_attention(iters=max(2, it // 4)))
         jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
                                             iters=max(2, it // 4)))
+        # masked head is the headline (the reference pretraining shape:
+        # decode only the 15% masked positions); the full-decode point
+        # ships alongside for continuity with r1-r4 artifacts
         jobs.append(lambda: bench_bert(iters=max(6, it // 2),
                                        pipelined_k=4))
+        jobs.append(lambda: bench_bert(iters=max(3, it // 4),
+                                       head="full"))
         # detection train step (device-side MultiBoxTarget, no callbacks):
         # the 128px smoke config plus an SSD300-scale capability config
         # (224px -> 16.5k anchors, ~1.9x real SSD300's 8732)

@@ -36,24 +36,30 @@ MASK_RATE = 0.15
 
 def synthetic_mlm_batch(rs, batch_size, seq_len, vocab, mask_id):
     """Markov token rows + random valid lengths; 15% of valid positions
-    masked.  Returns (tokens, valid_length, labels) with labels -1 off
-    the masked positions."""
+    masked.  Returns (tokens, valid_length, positions, labels): the
+    GluonNLP pretraining shape — ``positions`` (B, P) are the masked
+    slots the model decodes (P = 15% of seq_len; short rows pad with
+    position 0 / label -1, which the loss masks out)."""
+    n_pred = max(1, int(seq_len * MASK_RATE))
     toks = onp.zeros((batch_size, seq_len), onp.int64)
     state = rs.randint(5, vocab, batch_size)
     for t in range(seq_len):
         state = (state * 13 + rs.randint(0, 5, batch_size)) % (vocab - 5) + 5
         toks[:, t] = state
     vl = rs.randint(seq_len // 2, seq_len + 1, batch_size)
-    labels = onp.full((batch_size, seq_len), -1.0, onp.float32)
+    positions = onp.zeros((batch_size, n_pred), onp.int64)
+    labels = onp.full((batch_size, n_pred), -1.0, onp.float32)
     inp = toks.copy()
     for b in range(batch_size):
-        n_mask = max(1, int(vl[b] * MASK_RATE))
-        pos = rs.choice(vl[b], n_mask, replace=False)
-        labels[b, pos] = toks[b, pos]
+        n_mask = min(n_pred, max(1, int(vl[b] * MASK_RATE)))
+        pos = onp.sort(rs.choice(vl[b], n_mask, replace=False))
+        positions[b, :n_mask] = pos
+        labels[b, :n_mask] = toks[b, pos]
         inp[b, pos] = mask_id
         inp[b, vl[b]:] = 0
     return (mx.nd.array(inp.astype("float32")),
             mx.nd.array(vl.astype("int32"), dtype="int32"),
+            mx.nd.array(positions.astype("int32"), dtype="int32"),
             mx.nd.array(labels))
 
 
@@ -82,9 +88,9 @@ def main():
     net = ctor(vocab_size=args.vocab, max_length=args.seq_len,
                dropout=0.1, use_pooler=False, use_decoder=True)
     net.initialize(mx.init.Xavier())
-    tokens, vl, labels = synthetic_mlm_batch(
+    tokens, vl, positions, labels = synthetic_mlm_batch(
         rs, args.batch_size, args.seq_len, args.vocab, mask_id)
-    net(tokens, None, None, vl)             # materialize deferred shapes
+    net(tokens, None, None, vl, positions)  # materialize deferred shapes
     if args.dtype != "float32":
         net.cast(args.dtype)                # bf16: the AMP-equivalent tier
     net.collect_params().reset_ctx(mx.tpu())
@@ -97,14 +103,18 @@ def main():
     vocab = args.vocab
 
     class MLMLoss(gluon.loss.Loss):
-        """CE over MASKED positions only (labels -1 elsewhere)."""
+        """CE over the gathered masked positions (labels -1 = pad).
+
+        The model decodes ONLY ``masked_positions`` (B, P) — the vocab
+        projection never touches the other 85% of slots, exactly like
+        the GluonNLP pretraining pipeline."""
 
         def __init__(self):
             super().__init__(weight=None, batch_axis=0)
             self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
 
         def hybrid_forward(self, F, outputs, lab):
-            _, logits = outputs
+            _, logits = outputs                       # (B, P, vocab)
             flat = lab.reshape(-1)
             mask = (flat >= 0).reshape(-1, 1)
             ce = self._ce(logits.reshape(-1, vocab),
@@ -121,25 +131,31 @@ def main():
         total = 0.0
         for b in range(args.batches_per_epoch):
             if corpus is not None:
+                n_pred = max(1, int(args.seq_len * MASK_RATE))
                 rows = rs.randint(0, corpus.shape[0], args.batch_size)
                 toks = corpus[rows]
                 vl_np = onp.full(args.batch_size, args.seq_len)
-                labels_np = onp.full(toks.shape, -1.0, onp.float32)
+                pos_np = onp.zeros((args.batch_size, n_pred), onp.int64)
+                labels_np = onp.full((args.batch_size, n_pred), -1.0,
+                                     onp.float32)
                 inp = toks.copy()
                 for i in range(args.batch_size):
-                    pos = rs.choice(args.seq_len,
-                                    int(args.seq_len * MASK_RATE),
-                                    replace=False)
-                    labels_np[i, pos] = toks[i, pos]
+                    pos = onp.sort(rs.choice(args.seq_len, n_pred,
+                                             replace=False))
+                    pos_np[i] = pos
+                    labels_np[i] = toks[i, pos]
                     inp[i, pos] = mask_id
                 tokens = mx.nd.array(inp.astype("float32"))
                 vl = mx.nd.array(vl_np.astype("int32"), dtype="int32")
+                positions = mx.nd.array(pos_np.astype("int32"),
+                                        dtype="int32")
                 labels = mx.nd.array(labels_np)
             else:
-                tokens, vl, labels = synthetic_mlm_batch(
+                tokens, vl, positions, labels = synthetic_mlm_batch(
                     rs, args.batch_size, args.seq_len, args.vocab, mask_id)
             loss = step((tokens.as_in_context(mx.tpu()), None, None,
-                         vl.as_in_context(mx.tpu())),
+                         vl.as_in_context(mx.tpu()),
+                         positions.as_in_context(mx.tpu())),
                         labels.as_in_context(mx.tpu()))
             total += float(loss.asnumpy())
         n = args.batches_per_epoch

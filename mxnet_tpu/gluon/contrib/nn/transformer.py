@@ -65,7 +65,14 @@ class MultiHeadAttention(HybridBlock):
             # padding masks (per-row valid length) run INSIDE the flash
             # kernel — masked inside the online softmax, fully-masked key
             # blocks skipped — so padded batches (the normal BERT case)
-            # keep the fused path
+            # keep the fused path.  Layout: BHTD (explicit head
+            # transposes) — the transpose-free BSHD kernel
+            # (``flash_attention_bshd``) was measured END-TO-END slower
+            # here (BERT-base step 131.5 ms vs 121.7 ms): its 128-padded,
+            # 256-byte-strided head-column DMA costs more than the
+            # (B,L,H,D)->(B,H,L,D) transposes it avoids.  BSHD stays
+            # available for D=128 models, where neither pad nor stride
+            # penalty applies.
             out = F.flash_attention(q, k, v, kv_lens=valid_length,
                                     causal=self._causal)
         else:

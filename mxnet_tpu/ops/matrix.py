@@ -131,6 +131,18 @@ def gather_nd(data, indices):
     return data[idx]
 
 
+@register("_contrib_gather_positions", aliases=("gather_positions",))
+def gather_positions(data, positions):
+    """Per-row position gather: data (B, S, C), positions (B, P) int →
+    (B, P, C).  The TPU-native form of the gather GluonNLP's BERTModel
+    builds from ``gather_nd`` for masked-LM decoding (the reference
+    ecosystem decodes ONLY the ~15% masked positions, so the vocab
+    projection + softmax run on B*P rows, not B*S).  One XLA gather —
+    batched take_along_axis on the sequence axis."""
+    idx = jnp.clip(positions.astype(jnp.int32), 0, data.shape[1] - 1)
+    return jnp.take_along_axis(data, idx[:, :, None], axis=1)
+
+
 @register("scatter_nd")
 def scatter_nd(data, indices, shape=()):
     idx = tuple(indices.astype(jnp.int32))

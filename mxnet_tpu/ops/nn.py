@@ -310,9 +310,23 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5,
                output_mean_var: bool = False):
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
-    out = (data - mean) * lax.rsqrt(var + eps)
+    if jnp.dtype(data.dtype).itemsize < 4:
+        # low-precision inputs: one-pass E[x^2]-E[x]^2 stats in fp32 —
+        # both reductions fuse into a single read of x (jnp.var's
+        # two-pass form re-reads it) and the backward reduces over x
+        # once.  The fp32 accumulator has ~2^16 more mantissa headroom
+        # than the bf16 values, so the cancellation is benign HERE —
+        # fp32 inputs keep the two-pass form below precisely because it
+        # is not (values ~1e4 with std ~1 would cancel to garbage).
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axis, keepdims=True)
+        msq = jnp.mean(x32 * x32, axis=axis, keepdims=True)
+        var = jnp.maximum(msq - mean * mean, 0.0)
+        out = ((x32 - mean) * lax.rsqrt(var + eps)).astype(data.dtype)
+    else:
+        mean = jnp.mean(data, axis=axis, keepdims=True)
+        var = jnp.var(data, axis=axis, keepdims=True)
+        out = (data - mean) * lax.rsqrt(var + eps)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     return out * gamma.reshape(shape) + beta.reshape(shape)

@@ -485,6 +485,15 @@ def test_layernorm_vs_torch():
     ref = F.layer_norm(torch.from_numpy(x), (6,), torch.from_numpy(g),
                        torch.from_numpy(be), eps=1e-5).numpy()
     assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    # fp32 keeps the two-pass stats: a large common offset must not
+    # cancel the variance (the one-pass E[x^2]-E[x]^2 form is reserved
+    # for bf16, whose fp32 accumulator has the mantissa headroom)
+    xo = (x + 1e4).astype(onp.float32)
+    out = nd.LayerNorm(mx.nd.array(xo), mx.nd.array(g),
+                       mx.nd.array(be), eps=1e-5).asnumpy()
+    ref = F.layer_norm(torch.from_numpy(xo), (6,), torch.from_numpy(g),
+                       torch.from_numpy(be), eps=1e-5).numpy()
+    assert_almost_equal(out, ref, rtol=1e-3, atol=2e-3)
 
 
 def test_softmax_family_vs_torch():
@@ -635,6 +644,10 @@ COVERED_ELSEWHERE = {
     "quantized_act", "_contrib_quantized_act",
     # tested in tests/test_flash_attention.py (kernel + op + vjp)
     "flash_attention", "_contrib_flash_attention",
+    # BSHD layout variant: tests/test_flash_attention.py (bshd kernels)
+    "flash_attention_bshd", "_contrib_flash_attention_bshd",
+    # tests/test_transformer.py::test_gather_positions_op
+    "gather_positions", "_contrib_gather_positions",
     # tested in tests/test_round5_ops.py (reference-oracle checks)
     "SVMOutput", "svm_output", "IdentityAttachKLSparseReg",
     "identity_attach_KL_sparse_reg", "linalg_gelqf",

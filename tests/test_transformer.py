@@ -160,3 +160,71 @@ def test_bert_small_trains():
     losses = [float(step(tokens, labels).asnumpy())
               for _ in range(12)]
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_gather_positions_op():
+    """_contrib_gather_positions: (B,S,C) + (B,P) -> (B,P,C) rows."""
+    rs = onp.random.RandomState(3)
+    data = rs.randn(2, 8, 4).astype("float32")
+    pos = onp.array([[0, 3, 7], [5, 5, 1]], "int32")
+    out = mx.nd.gather_positions(mx.nd.array(data),
+                                 mx.nd.array(pos, dtype="int32")).asnumpy()
+    for b in range(2):
+        for i, p in enumerate(pos[b]):
+            assert onp.allclose(out[b, i], data[b, p])
+
+
+def test_bert_masked_positions_decodes_gathered_rows():
+    """BERTModel(masked_positions=...) returns MLM logits only at the
+    gathered positions, equal to the full-decode logits there (the
+    GluonNLP pretraining interface: decode the 15%, not all S)."""
+    rs = onp.random.RandomState(9)
+    net = bert_small(vocab_size=200, max_length=32, dropout=0.0,
+                     use_pooler=False, use_decoder=True)
+    net.initialize(mx.init.Xavier())
+    B, L, P = 2, 32, 5
+    tokens = mx.nd.array(rs.randint(0, 200, (B, L)).astype("float32"))
+    vl = mx.nd.array(onp.array([32, 20], "int32"), dtype="int32")
+    pos = onp.sort(rs.choice(20, (B, P), replace=True), 1).astype("int32")
+    seq_m, logits_m = net(tokens, None, None, vl,
+                          mx.nd.array(pos, dtype="int32"))
+    seq_f, logits_f = net(tokens, None, None, vl)
+    assert logits_m.shape == (B, P, 200)
+    lm, lf = logits_m.asnumpy(), logits_f.asnumpy()
+    for b in range(B):
+        for i, p in enumerate(pos[b]):
+            assert onp.abs(lm[b, i] - lf[b, p]).max() < 1e-4
+    # the sequence output is unchanged by the gather
+    assert onp.abs(seq_m.asnumpy() - seq_f.asnumpy()).max() < 1e-6
+
+
+def test_bert_masked_positions_trains():
+    """MLM loss over gathered positions descends end to end (the bench's
+    masked-head configuration)."""
+    rs = onp.random.RandomState(11)
+    V, B, L, P = 120, 4, 24, 4
+    net = bert_small(vocab_size=V, max_length=L, dropout=0.0,
+                     use_pooler=False, use_decoder=True)
+    net.initialize(mx.init.Xavier())
+    tokens = mx.nd.array(rs.randint(5, V, (B, L)).astype("float32"))
+    vl = mx.nd.array(onp.full(B, L, "int32"), dtype="int32")
+    pos = mx.nd.array(
+        onp.sort(rs.choice(L, (B, P), replace=False), 1).astype("int32"),
+        dtype="int32")
+    labels = mx.nd.array(rs.randint(0, V, (B, P)).astype("float32"))
+    net(tokens, None, None, vl, pos)
+
+    class Loss(gluon.loss.Loss):
+        def __init__(self):
+            super().__init__(weight=None, batch_axis=0)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, outputs, lab):
+            _, logits = outputs
+            return self._ce(logits.reshape(-1, V), lab.reshape(-1))
+
+    step = mx.parallel.DataParallelStep(
+        net, Loss(), mx.optimizer.Adam(learning_rate=5e-3), mesh=None)
+    losses = [float(step((tokens, None, None, vl, pos),
+                         labels).mean().asscalar()) for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.9, losses

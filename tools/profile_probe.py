@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Per-op device-time profile of a bench step (scratch tool for the
+roofline notes; findings land in bench.py / kernel defaults).
+
+Captures a jax.profiler trace around k executions of a bench step and
+aggregates the device-lane op durations from the perfetto trace.json.gz,
+printing the top-N ops by total device time.
+
+    python tools/profile_probe.py --what bert
+    python tools/profile_probe.py --what train --top 30
+"""
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def collect(trace_dir):
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        raise RuntimeError("no trace.json.gz under %s" % trace_dir)
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device lanes: pid whose process_name mentions TPU/device; fall back
+    # to lanes that carry XLA op events (they have 'run_id'/'long_name'
+    # args or hlo-ish names)
+    names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names[e["pid"]] = e["args"].get("name", "")
+    device_pids = {p for p, n in names.items()
+                   if "TPU" in n or "/device" in n.lower()}
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    fam = defaultdict(float)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        dur = e.get("dur", 0)
+        n = e["name"]
+        # drop module/program spans (parents that double-count their
+        # children): jit_* wrappers and bare numeric step markers
+        if not dur or n.startswith("jit_") or n.isdigit():
+            continue
+        agg[n] += dur
+        cnt[n] += 1
+        fam[n.split(".")[0]] += dur
+    return agg, cnt, fam, names
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--what", default="bert",
+                    choices=["bert", "train", "attention", "lstm"])
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import bench  # repo-root bench.py via the path insert above
+
+    d = tempfile.mkdtemp(prefix="profprobe_")
+
+    if args.what == "bert":
+        # lean: ONE step function, compiled once, traced per-call (the
+        # full bench_bert would recompile its whole matrix twice)
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon
+        from mxnet_tpu.gluon.model_zoo import bert_base
+        from mxnet_tpu.parallel import DataParallelStep
+        vocab = 30522
+        batch_size, seq_len = 24, 512
+        net = bert_base(vocab_size=vocab, max_length=seq_len, dropout=0.0,
+                        use_pooler=False, use_decoder=True)
+        net.initialize(mx.init.Xavier())
+        rs = onp.random.RandomState(0)
+        host_tokens = mx.nd.array(rs.randint(0, vocab, (batch_size,
+                                                        seq_len))
+                                  .astype("float32"))
+        lens = rs.randint(seq_len // 3, seq_len + 1, (batch_size,))
+        lens[: max(1, batch_size // 4)] = seq_len
+        host_vl = mx.nd.array(lens.astype("int32"), dtype="int32")
+        n_pred = max(1, int(seq_len * 0.15))
+        host_pos = mx.nd.array(
+            onp.sort(onp.stack([rs.choice(int(lens.min()), n_pred,
+                                          replace=False)
+                                for _ in range(batch_size)]), 1)
+            .astype("int32"), dtype="int32")
+        net(host_tokens, None, None, host_vl, host_pos)
+        net.cast("bfloat16")
+        net.collect_params().reset_ctx(mx.tpu())
+        tokens = mx.nd.array(host_tokens.asnumpy(), ctx=mx.tpu())
+        labels = mx.nd.array(rs.randint(0, vocab, (batch_size, n_pred))
+                             .astype("float32"), ctx=mx.tpu())
+        vl = mx.nd.array(host_vl.asnumpy(), ctx=mx.tpu(), dtype="int32")
+        pos = mx.nd.array(host_pos.asnumpy(), ctx=mx.tpu(), dtype="int32")
+
+        class MLMLoss(gluon.loss.Loss):
+            def __init__(self):
+                super().__init__(weight=None, batch_axis=0)
+                self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+            def hybrid_forward(self, F, outputs, lab):
+                _, logits = outputs
+                return self._ce(logits.reshape(-1, vocab),
+                                lab.reshape(-1))
+
+        step = DataParallelStep(net, MLMLoss(),
+                                mx.optimizer.Adam(learning_rate=1e-4),
+                                mesh=None)
+        run = lambda: step((tokens, None, None, vl, pos), labels)
+        runner = lambda: [bench._sync(run()) for _ in range(args.steps)]
+    elif args.what == "train":
+        bs = args.batch_size or 128
+        step, data, label = bench._build_train_step(
+            "resnet50_v1", bs, "bfloat16")
+        runner = lambda: [bench._sync(step(data, label))
+                          for _ in range(args.steps)]
+    elif args.what == "lstm":
+        runner = lambda: bench.bench_lstm(iters=args.steps)
+    else:
+        runner = lambda: bench.bench_attention(iters=args.steps)
+
+    for _ in range(2):
+        runner()  # warm: compile + settle donation layouts pre-capture
+    jax.profiler.start_trace(d)
+    out = runner()
+    jax.profiler.stop_trace()
+    print("# steps traced:", args.steps, flush=True)
+
+    agg, cnt, fam, names = collect(d)
+    total = sum(agg.values())
+    print("# device lanes: %s" % sorted(set(names.values()))[:8])
+    print("# total device-op us (HLO level): %.0f" % total)
+    print("# --- op families ---")
+    for name, us in sorted(fam.items(), key=lambda kv: -kv[1])[:args.top]:
+        print(json.dumps({"family": name[:80], "us": round(us, 0),
+                          "pct": round(100 * us / total, 1)}))
+    print("# --- top individual ops ---")
+    for name, us in sorted(agg.items(), key=lambda kv: -kv[1])[:args.top]:
+        print(json.dumps({"op": name[:110], "us": round(us, 0),
+                          "pct": round(100 * us / total, 1),
+                          "n": cnt[name]}))
+
+
+if __name__ == "__main__":
+    main()
