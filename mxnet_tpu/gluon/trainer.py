@@ -15,6 +15,7 @@ from __future__ import annotations
 from .. import autograd
 from .. import kvstore as kvs
 from .. import optimizer as opt
+from ..optimizer.optimizer import pin_update_dtypes as _pin_update_dtypes
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
@@ -106,8 +107,11 @@ class _FusedUpdate:
                 for k, step in enumerate(steps):
                     res = step(wvals[k], gvals[k], t,
                                lr_vec[k].astype(wvals[k].dtype), *svals[k])
-                    new_w.append(res[0])
-                    new_s.append(list(res[1:]))
+                    # traced-t bias corrections are strong f32; pin the
+                    # carry (see optimizer.pin_update_dtypes)
+                    nw, ns = _pin_update_dtypes(res, wvals[k], svals[k])
+                    new_w.append(nw)
+                    new_s.append(ns)
                 return new_w, new_s
 
             # donate weights + states: the update is in-place at the XLA

@@ -310,6 +310,12 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5,
                output_mean_var: bool = False):
+    if axis in (-1, data.ndim - 1) and not output_mean_var:
+        # fused Pallas kernels on TPU (one read + one write fwd, fused
+        # bwd with in-VMEM dgamma/dbeta accumulation); profiled ~38% of
+        # the BERT step as XLA-composed convert/reduce chains before
+        from .pallas_layernorm import fused_layer_norm
+        return fused_layer_norm(data, gamma, beta, float(eps))
     if jnp.dtype(data.dtype).itemsize < 4:
         # low-precision inputs: one-pass E[x^2]-E[x]^2 stats in fp32 —
         # both reductions fuse into a single read of x (jnp.var's

@@ -22,11 +22,14 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as onp
+
 import jax
 import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from ..optimizer import optimizer as _opt
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap
 from .mesh import get_mesh
@@ -108,13 +111,29 @@ class DataParallelStep:
         self._params = params
         self._trainable = [i for i, p in enumerate(params)
                            if p.grad_req != "null"]
-        # optimizer state pytrees per trainable param (flattened to leaves)
+        # optimizer state pytrees per trainable param (flattened to
+        # leaves).  With optimizer.multi_precision, half-width (bf16/
+        # fp16) weights carry an fp32 MASTER copy as the first state
+        # leaf (reference mp_sgd/mp_adam kernels): the forward runs the
+        # half weight, the update applies to the master in fp32, and
+        # the half weight is re-quantized from it each step — small
+        # updates accumulate instead of rounding away.
         self._opt_states = []
         self._state_treedefs = []
+        self._mp_slots = []
+        self._mp_written = {}   # slot -> last weight array THIS step wrote
+        mp = bool(getattr(optimizer, "multi_precision", False))
         for slot, i in enumerate(self._trainable):
-            st = optimizer.create_state(slot, params[i].data())
+            wdata = params[i].data()
+            use_mp = mp and onp.dtype(wdata.dtype).itemsize < 4
+            self._mp_slots.append(use_mp)
+            if use_mp:
+                wdata = wdata.astype("float32")   # master (state dtype f32)
+            st = optimizer.create_state(slot, wdata)
             leaves, treedef = jax.tree_util.tree_flatten(
                 st, is_leaf=lambda x: isinstance(x, NDArray))
+            if use_mp:
+                leaves = [wdata] + leaves     # master rides as leaf 0
             # commit state buffers to the weight's device so the first call
             # and post-donation calls see identical arg shardings (one
             # compile, not two)
@@ -224,12 +243,25 @@ class DataParallelStep:
             self._rng_dev = _random.next_key()
             self._rng_epoch = _random.seed_epoch()
         pvals = [p._data._data for p in self._params]
+        # multi-precision master resync: the fp32 master (state leaf 0)
+        # is the source of truth for the update, so an externally
+        # mutated weight (load_parameters / set_data after construction)
+        # must refresh it — otherwise the next step would silently
+        # restore the stale master's value
+        for slot, i in enumerate(self._trainable):
+            if self._mp_slots[slot] and \
+                    self._mp_written.get(slot) is not pvals[i]:
+                self._opt_states[slot][0] = jnp.asarray(pvals[i],
+                                                        jnp.float32)
         new_pvals, new_states, self._t_dev, self._rng_dev, loss = jfn(
             pvals, self._opt_states, self._t_dev, self._lrs_dev,
             self._rng_dev, dval, lval)
         for p, v in zip(self._params, new_pvals):
             with autograd.pause():
                 p._data._data = v
+        for slot, i in enumerate(self._trainable):
+            if self._mp_slots[slot]:
+                self._mp_written[slot] = new_pvals[i]
         self._opt_states = new_states
         return _wrap(loss)
 
@@ -239,6 +271,7 @@ class DataParallelStep:
         params = self._params
         trainable = self._trainable
         treedefs = self._state_treedefs
+        mp_slots = self._mp_slots
         n = len(params)
         trainset = set(trainable)
         steps = [optimizer.make_step(slot) for slot, _ in enumerate(trainable)]
@@ -300,12 +333,25 @@ class DataParallelStep:
             new_states = []
             for slot, (i, g) in enumerate(zip(trainable, grads)):
                 st_leaves = opt_states[slot]
-                # cast to the weight dtype so a strong f32 lr never upcasts
-                # bf16/fp16 params through the update arithmetic
+                if mp_slots[slot]:
+                    # fp32 master path (reference mp_* kernels): update
+                    # the master, re-quantize the working weight from it
+                    master, rest = st_leaves[0], st_leaves[1:]
+                    res = steps[slot](master, g.astype(jnp.float32), t,
+                                      lrs[slot], *rest)
+                    new_master, new_rest = _opt.pin_update_dtypes(
+                        res, master, rest)
+                    new_pvals[i] = new_master.astype(pvals[i].dtype)
+                    new_states.append([new_master] + new_rest)
+                    continue
                 res = steps[slot](pvals[i], g, t,
                                   lrs[slot].astype(pvals[i].dtype), *st_leaves)
-                new_pvals[i] = res[0]
-                new_states.append(list(res[1:]))
+                # see optimizer.pin_update_dtypes: traced-t bias
+                # corrections are strong f32 and once silently rewrote
+                # bf16 params as f32 from step 2 on
+                new_pvals[i], new_st = _opt.pin_update_dtypes(
+                    res, pvals[i], st_leaves)
+                new_states.append(new_st)
             for i, v in mutated.items():
                 new_pvals[i] = v
             return new_pvals, new_states, t + 1, next_key, loss_val

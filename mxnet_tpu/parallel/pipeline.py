@@ -31,6 +31,7 @@ __all__ = ["pipeline_apply", "pipeline_train_step", "PipelineTrainer"]
 
 
 from .mesh import shard_map_compat as _shard_map  # noqa: E402
+from ..optimizer.optimizer import pin_update_dtypes as _pin_update_dtypes  # noqa: E402
 
 
 def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh,
@@ -220,8 +221,11 @@ class PipelineTrainer:
             new_leaves, new_states = [], []
             for i, (w, g) in enumerate(zip(leaves, grads)):
                 res = steps[i](w, g, t, lr.astype(w.dtype), *states[i])
-                new_leaves.append(res[0])
-                new_states.append(list(res[1:]))
+                # traced-t bias corrections are strong f32; pin the
+                # carry (see optimizer.pin_update_dtypes)
+                nw, ns = _pin_update_dtypes(res, w, states[i])
+                new_leaves.append(nw)
+                new_states.append(ns)
             return new_leaves, new_states, t + 1, loss
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))

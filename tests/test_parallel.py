@@ -271,3 +271,82 @@ def test_data_parallel_step_advances_lr_schedule(mesh8):
         prev = cur
     # updates 1,2 at lr=1.0; updates 3,4 at lr=0.5
     onp.testing.assert_allclose(deltas, [1.0, 1.0, 0.5, 0.5], rtol=1e-5)
+
+
+def test_data_parallel_step_preserves_param_dtypes():
+    """bf16 params and optimizer state must stay bf16 across steps: the
+    traced Adam bias correction (b2 ** t with a TRACED t) is strong f32
+    and once silently rewrote every param as f32 after the first step,
+    running the whole model at 2x HBM traffic from step 2 on."""
+    rs = onp.random.RandomState(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(rs.rand(8, 8).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 4, 8).astype("float32"))
+    net(x)
+    net.cast("bfloat16")
+    step = parallel.DataParallelStep(
+        net, gloss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.Adam(learning_rate=1e-3), mesh=None)
+    state_dtypes = [[str(leaf.dtype) for leaf in leaves]
+                    for leaves in step._opt_states]
+    for _ in range(3):
+        step(x, y)
+    for _, p in net.collect_params().items():
+        assert p.data().dtype == onp.dtype("bfloat16"), p.name
+    after = [[str(leaf.dtype) for leaf in leaves]
+             for leaves in step._opt_states]
+    assert after == state_dtypes, (state_dtypes, after)
+
+
+def test_data_parallel_step_multi_precision_master():
+    """optimizer.multi_precision carries an fp32 master for bf16 params
+    (reference mp_sgd/mp_adam kernels): the working weight stays bf16,
+    state (incl. master) stays f32, and training descends."""
+    rs = onp.random.RandomState(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(rs.rand(8, 8).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 4, 8).astype("float32"))
+    net(x)
+    net.cast("bfloat16")
+    step = parallel.DataParallelStep(
+        net, gloss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.Adam(learning_rate=2e-2, multi_precision=True),
+        mesh=None)
+    assert all(step._mp_slots)
+    assert all(str(l.dtype) == "float32"
+               for lv in step._opt_states for l in lv)
+    losses = [float(step(x, y).mean().asscalar()) for _ in range(25)]
+    for _, p in net.collect_params().items():
+        assert p.data().dtype == onp.dtype("bfloat16")
+    assert all(str(l.dtype) == "float32"
+               for lv in step._opt_states for l in lv)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_multi_precision_master_resyncs_on_external_set_data():
+    """Externally mutated weights (checkpoint restore) must refresh the
+    fp32 master, not be reverted by the next step."""
+    rs = onp.random.RandomState(0)
+    net = nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(rs.rand(8, 8).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 4, 8).astype("float32"))
+    net(x)
+    net.cast("bfloat16")
+    step = parallel.DataParallelStep(
+        net, gloss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.Adam(learning_rate=1e-3, multi_precision=True),
+        mesh=None)
+    step(x, y)
+    loaded = onp.full(net.weight.shape, 0.25, "float32")
+    net.weight.set_data(mx.nd.array(loaded, dtype="bfloat16"))
+    step(x, y)
+    w = net.weight.data().asnumpy().astype("float32")
+    # one small-lr step away from the loaded value, NOT the stale master
+    assert onp.abs(w - loaded).max() < 0.05, w

@@ -153,3 +153,36 @@ def test_metrics():
     lbl = mx.nd.array(onp.array([0, 1, 2, 0]))
     pp.update([lbl], [prob])
     assert abs(pp.get()[1] - 3.0) < 1e-3
+
+
+def test_trainer_multi_precision_bf16_master():
+    """gluon.Trainer with multi_precision keeps bf16 params while the
+    updater trains an fp32 master (reference update_multi_precision;
+    extended to bf16, the TPU half tier)."""
+    import numpy as onp
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    rs = onp.random.RandomState(3)
+    net = nn.Dense(1)
+    net.initialize(mx.init.Xavier())
+    X = mx.nd.array(rs.rand(32, 4).astype("float32"))
+    Yv = (X.asnumpy() @ onp.array([[1.0], [-2.0], [0.5], [3.0]],
+                                  "float32")).astype("float32")
+    Y = mx.nd.array(Yv)
+    net(X)
+    net.cast("bfloat16")
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05,
+                             "multi_precision": True})
+    loss_fn = gluon.loss.L2Loss()
+    first = last = None
+    for _ in range(30):
+        with autograd.record():
+            loss = loss_fn(net(X.astype("bfloat16")), Y.astype("bfloat16"))
+        loss.backward()
+        trainer.step(32)
+        last = float(loss.mean().asscalar())
+        if first is None:
+            first = last
+    assert net.weight.data().dtype == onp.dtype("bfloat16")
+    assert last < first * 0.5, (first, last)
