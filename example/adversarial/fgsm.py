@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples (reference
+``example/adversary/adversary_generation.ipynb``).
+
+Trains a small classifier on synthetic blob digits, then perturbs test
+inputs along sign(∂loss/∂x) — the gradient w.r.t. the INPUT, taken by
+attaching a grad to the data array (``x.attach_grad()`` +
+``autograd.record``), the same imperative input-gradient path the
+reference notebook uses.  Accuracy on the perturbed batch should
+collapse while clean accuracy stays high.
+
+    python example/adversarial/fgsm.py
+    python example/adversarial/fgsm.py --epsilon 0.3
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def synthetic_digits(rs, n, num_classes):
+    X = rs.rand(n, 64).astype("float32") * 0.3
+    Y = rs.randint(0, num_classes, n)
+    for i, k in enumerate(Y):
+        X[i, int(k) * 6:int(k) * 6 + 6] += 1.0
+    return X, Y.astype("float32")
+
+
+def accuracy(net, X, Y):
+    pred = net(X).asnumpy().argmax(axis=1)
+    return float((pred == Y.asnumpy()).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epsilon", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = onp.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"),
+                nn.Dense(args.num_classes))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.2})
+
+    Xtr, Ytr = synthetic_digits(rs, 1024, args.num_classes)
+    for epoch in range(args.epochs):
+        perm = rs.permutation(len(Xtr))
+        total = 0.0
+        for s in range(0, len(Xtr), args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            x = mx.nd.array(Xtr[idx])
+            y = mx.nd.array(Ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(len(idx))
+            total += float(loss.mean().asscalar())
+        logging.info("epoch %d loss %.4f", epoch,
+                     total / (len(Xtr) // args.batch_size))
+
+    Xt, Yt = synthetic_digits(onp.random.RandomState(args.seed + 1), 256,
+                              args.num_classes)
+    x = mx.nd.array(Xt)
+    y = mx.nd.array(Yt)
+    clean_acc = accuracy(net, x, y)
+
+    # FGSM: one gradient step on the INPUT
+    x.attach_grad()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    x_adv = x + args.epsilon * x.grad.sign()
+    adv_acc = accuracy(net, x_adv, y)
+
+    logging.info("clean accuracy: %.3f", clean_acc)
+    logging.info("adversarial accuracy (eps=%.2f): %.3f", args.epsilon,
+                 adv_acc)
+    assert clean_acc > 0.9, clean_acc
+    assert adv_acc < clean_acc - 0.2, (clean_acc, adv_acc)
+    print("FGSM_DROP %.3f -> %.3f" % (clean_acc, adv_acc))
+
+
+if __name__ == "__main__":
+    main()

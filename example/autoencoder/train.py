@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Stacked dense autoencoder (reference ``example/autoencoder/`` —
+the AutoEncoderModel pretrain+finetune recipe, condensed to the
+end-to-end finetune phase).
+
+Encoder 64→32→8, decoder mirrors it; L2 reconstruction loss; optional
+``--denoise`` adds input noise like the reference's corruption stage.
+Reconstruction MSE on held-out data must drop well below the variance
+of the inputs (the trivial predict-the-mean baseline).
+
+    python example/autoencoder/train.py
+    python example/autoencoder/train.py --denoise 0.2
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def build(dims=(32, 8)):
+    enc = nn.HybridSequential(prefix="enc_")
+    with enc.name_scope():
+        for d in dims[:-1]:
+            enc.add(nn.Dense(d, activation="relu"))
+        enc.add(nn.Dense(dims[-1]))
+    dec = nn.HybridSequential(prefix="dec_")
+    with dec.name_scope():
+        for d in reversed(dims[:-1]):
+            dec.add(nn.Dense(d, activation="relu"))
+        dec.add(nn.Dense(64))
+    net = nn.HybridSequential()
+    net.add(enc, dec)
+    return net
+
+
+def low_rank_data(rs, n, U):
+    """Samples on a fixed rank-r manifold in 64-d: compressible to 8
+    codes (train and test share the SAME subspace U)."""
+    Z = rs.randn(n, U.shape[0]).astype("float32")
+    return Z @ U + 0.05 * rs.randn(n, 64).astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--denoise", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = onp.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+
+    U = rs.randn(6, 64).astype("float32")
+    Xtr = low_rank_data(rs, 2048, U)
+    Xte = low_rank_data(onp.random.RandomState(args.seed + 1), 256, U)
+    it = mx.io.NDArrayIter(Xtr, batch_size=args.batch_size, shuffle=True,
+                           last_batch_handle="discard")
+
+    net = build()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    for epoch in range(args.epochs):
+        it.reset()
+        total, n = 0.0, 0
+        for batch in it:
+            x = batch.data[0]
+            inp = x
+            if args.denoise:
+                noise = mx.nd.array(
+                    rs.randn(*x.shape).astype("float32") * args.denoise)
+                inp = x + noise
+            with autograd.record():
+                loss = loss_fn(net(inp), x)
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.mean().asscalar()) * x.shape[0]
+            n += x.shape[0]
+        logging.info("epoch %d recon l2 %.4f", epoch, total / n)
+
+    xte = mx.nd.array(Xte)
+    mse = float(((net(xte) - xte) ** 2).mean().asscalar())
+    baseline = float(Xte.var())
+    logging.info("test recon mse %.4f vs input variance %.4f", mse,
+                 baseline)
+    assert mse < 0.5 * baseline, (mse, baseline)
+    print("RECON_MSE %.4f baseline %.4f" % (mse, baseline))
+
+
+if __name__ == "__main__":
+    main()

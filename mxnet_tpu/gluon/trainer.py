@@ -12,6 +12,8 @@ op per parameter (``optimizer.py _apply``).
 """
 from __future__ import annotations
 
+import numpy as onp
+
 from .. import autograd
 from .. import kvstore as kvs
 from .. import optimizer as opt
@@ -62,8 +64,6 @@ class _FusedUpdate:
         import jax.numpy as jnp
         from ..ndarray.sparse import RowSparseNDArray
         optimizer = self._updater.optimizer
-        if optimizer.multi_precision:
-            return False
         if any(isinstance(g, RowSparseNDArray) and g.has_parts
                for g in grads):
             # parts-backed sparse grads must reach the optimizer's lazy
@@ -91,6 +91,10 @@ class _FusedUpdate:
             (k, v) for k, v in vars(optimizer).items()
             if isinstance(v, (int, float, bool, str, type(None)))
             and k not in ("num_update", "begin_num_update")))
+        # per-weight multi-precision flags are static at trace time; the
+        # weight-dtype tuple in the key covers them
+        mp_flags = [optimizer.multi_precision
+                    and onp.dtype(w.dtype).itemsize < 4 for w in weights]
         key = (tuple(indices), fingerprint,
                tuple(optimizer._get_wds(list(indices))),
                tuple((w.shape, str(w.dtype)) for w in weights))
@@ -105,6 +109,17 @@ class _FusedUpdate:
             def fused(wvals, gvals, svals, t, lr_vec):
                 new_w, new_s = [], []
                 for k, step in enumerate(steps):
+                    if mp_flags[k]:
+                        # fp32 master path (reference mp_* kernels):
+                        # state leaf 0 is the master; update it in f32
+                        # and re-quantize the working weight from it
+                        master, rest = svals[k][0], svals[k][1:]
+                        res = step(master, gvals[k].astype(jnp.float32),
+                                   t, lr_vec[k], *rest)
+                        nm, ns = _pin_update_dtypes(res, master, rest)
+                        new_w.append(nm.astype(wvals[k].dtype))
+                        new_s.append([nm] + ns)
+                        continue
                     res = step(wvals[k], gvals[k], t,
                                lr_vec[k].astype(wvals[k].dtype), *svals[k])
                     # traced-t bias corrections are strong f32; pin the

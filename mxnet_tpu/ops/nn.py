@@ -13,6 +13,7 @@ assignment re-tiles for the TPU's native layouts internally.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -310,10 +311,14 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5,
                output_mean_var: bool = False):
-    if axis in (-1, data.ndim - 1) and not output_mean_var:
-        # fused Pallas kernels on TPU (one read + one write fwd, fused
-        # bwd with in-VMEM dgamma/dbeta accumulation); profiled ~38% of
-        # the BERT step as XLA-composed convert/reduce chains before
+    if axis in (-1, data.ndim - 1) and not output_mean_var \
+            and os.environ.get("MXNET_FUSED_LAYERNORM", "") == "1":
+        # opt-in fused Pallas kernels (one read + one write fwd, fused
+        # bwd with in-VMEM dgamma/dbeta accumulation).  Not the default:
+        # custom_vjp breaks forward-mode autodiff, and on the BERT bench
+        # the fused path measured wall-clock-neutral (the step is bound
+        # by gemms/attention/optimizer, not LN) — see
+        # pallas_layernorm.fused_layer_norm.
         from .pallas_layernorm import fused_layer_norm
         return fused_layer_norm(data, gamma, beta, float(eps))
     if jnp.dtype(data.dtype).itemsize < 4:

@@ -82,12 +82,18 @@ def _pad_rows(x, block):
 
 def pallas_layer_norm_fwd(x2d, gamma, beta, eps, block_rows=_BLOCK_ROWS,
                           interpret=False):
-    """x2d (N, C) → (y (N, C), mu (N, 1) f32, rstd (N, 1) f32)."""
+    """x2d (N, C) → (y (N, C), mu (N, 1) f32, rstd (N, 1) f32).
+
+    y's dtype follows jnp promotion over (x, gamma, beta) — identical to
+    the composed ``(x-mu)*rstd*gamma+beta`` expression, so mixed-dtype
+    (bf16 data, f32 affine) models see the same dtypes either path."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     N, C = x2d.shape
-    block = min(block_rows, max(8, N))
+    out_dtype = jnp.result_type(x2d.dtype, gamma.dtype, beta.dtype)
+    # keep the block a multiple of 8 sublanes (padding handles the tail)
+    block = min(block_rows, max(8, -(-N // 8) * 8))
     xp, Np = _pad_rows(x2d, block)
     grid = (Np // block,)
     g2 = gamma.reshape(1, C)
@@ -106,7 +112,7 @@ def pallas_layer_norm_fwd(x2d, gamma, beta, eps, block_rows=_BLOCK_ROWS,
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Np, C), x2d.dtype),
+            jax.ShapeDtypeStruct((Np, C), out_dtype),
             jax.ShapeDtypeStruct((Np, 1), jnp.float32),
             jax.ShapeDtypeStruct((Np, 1), jnp.float32),
         ],
@@ -117,12 +123,12 @@ def pallas_layer_norm_fwd(x2d, gamma, beta, eps, block_rows=_BLOCK_ROWS,
 
 def pallas_layer_norm_bwd(x2d, gamma, mu, rstd, ct2d,
                           block_rows=_BLOCK_ROWS, interpret=False):
-    """→ (dx (N, C), dgamma (C,) f32, dbeta (C,) f32)."""
+    """→ (dx (N, C) in x's dtype, dgamma (C,) f32, dbeta (C,) f32)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     N, C = x2d.shape
-    block = min(block_rows, max(8, N))
+    block = min(block_rows, max(8, -(-N // 8) * 8))
     xp, Np = _pad_rows(x2d, block)
     # padded cotangent rows are zero, so they add nothing to dg/db and
     # their dx rows are sliced away
@@ -146,7 +152,7 @@ def pallas_layer_norm_bwd(x2d, gamma, mu, rstd, ct2d,
             pl.BlockSpec((1, C), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Np, C), ct2d.dtype),
+            jax.ShapeDtypeStruct((Np, C), x2d.dtype),
             jax.ShapeDtypeStruct((1, C), jnp.float32),
             jax.ShapeDtypeStruct((1, C), jnp.float32),
         ],
@@ -182,9 +188,15 @@ def _pick_block_rows(C):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def fused_layer_norm(data, gamma, beta, eps=1e-5):
     """Last-axis LayerNorm with fused TPU kernels (jnp fallback off-TPU
-    and under interpret-less CPU tracing).  Matches
-    ``ops.nn.layer_norm(axis=-1)`` semantics bit-for-bit at the fp32-
-    stats level."""
+    and for channel sizes past the VMEM budget).  Output dtype follows
+    jnp promotion over (data, gamma, beta), like the composed form.
+
+    Being a ``custom_vjp``, this supports reverse-mode only — forward-
+    mode autodiff (jvp/hessians) raises.  That is why the LayerNorm op
+    routes here only when ``MXNET_FUSED_LAYERNORM=1`` (opt-in): the
+    fused kernels cut the LN HLO families ~4x in isolation but measured
+    wall-clock-neutral on the BERT step (the step is bound elsewhere),
+    so jvp-compatibility wins by default."""
     return _fln_fwd(data, gamma, beta, eps)[0]
 
 

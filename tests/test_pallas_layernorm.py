@@ -99,3 +99,44 @@ def test_huge_channel_falls_back_to_generic_path():
     out = pln.fused_layer_norm(x, g, b, 1e-5)  # CPU: fallback either way
     ref = pln._jnp_ln(x, g, b, 1e-5)
     assert float(jnp.abs(out - ref).max()) < 1e-6
+
+
+def test_default_layer_norm_supports_forward_mode():
+    """The default LayerNorm path must stay jvp-differentiable (the
+    fused custom_vjp kernels are opt-in via MXNET_FUSED_LAYERNORM=1
+    precisely because custom_vjp breaks forward mode)."""
+    from mxnet_tpu.ops.nn import layer_norm
+    x = jnp.asarray(onp.random.RandomState(0).randn(4, 16).astype("f"))
+    g = jnp.ones(16)
+    b = jnp.zeros(16)
+    out, tangent = jax.jvp(lambda a: layer_norm(a, g, b), (x,),
+                           (jnp.ones_like(x),))
+    assert out.shape == tangent.shape == x.shape
+
+
+def test_fused_kernels_mixed_dtype_promotes_like_composition():
+    """bf16 data with fp32 affine params: the kernel's output dtype and
+    values match the composed jnp expression (partial-AMP models)."""
+    rs = onp.random.RandomState(4)
+    x = jnp.asarray(rs.randn(16, 128).astype("float32"), jnp.bfloat16)
+    g = jnp.asarray((rs.rand(128) + 0.5).astype("float32"))
+    b = jnp.asarray(rs.randn(128).astype("float32"))
+    y, _, _ = pln.pallas_layer_norm_fwd(x, g, b, 1e-5, block_rows=8,
+                                        interpret=True)
+    ref = pln._jnp_ln(x, g, b, 1e-5)
+    assert y.dtype == ref.dtype == jnp.float32
+    assert float(jnp.abs(y - ref).max()) < 0.02
+
+
+def test_fused_env_knob_routes_to_kernels(monkeypatch):
+    """MXNET_FUSED_LAYERNORM=1 flips the op onto the fused path (jnp
+    fallback on CPU, same values)."""
+    from mxnet_tpu.ops.nn import layer_norm
+    monkeypatch.setenv("MXNET_FUSED_LAYERNORM", "1")
+    rs = onp.random.RandomState(6)
+    x = jnp.asarray(rs.randn(4, 32).astype("f"))
+    g = jnp.asarray((rs.rand(32) + 0.5).astype("f"))
+    b = jnp.asarray(rs.randn(32).astype("f"))
+    out = layer_norm(x, g, b)
+    ref = pln._jnp_ln(x, g, b, 1e-5)
+    assert float(jnp.abs(out - ref).max()) < 1e-5

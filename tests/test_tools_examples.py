@@ -328,3 +328,30 @@ def test_bandwidth_probe_measures_links():
     assert r["devices"] == 8
     for k in ("h2d_gbs", "d2h_gbs", "copy_gbs", "allreduce_gbs"):
         assert r[k] > 0, (k, r)
+
+
+def test_fgsm_adversarial_example():
+    """FGSM input-gradient attack collapses accuracy (reference
+    example/adversary)."""
+    script = os.path.join(REPO, "example", "adversarial", "fgsm.py")
+    res = subprocess.run(
+        [sys.executable, script, "--epochs", "5"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"FGSM_DROP ([0-9.]+) -> ([0-9.]+)",
+                  res.stdout + res.stderr)
+    assert m and float(m.group(2)) < float(m.group(1)) - 0.2, \
+        (res.stdout + res.stderr)[-400:]
+
+
+def test_autoencoder_example_reconstructs():
+    """Autoencoder reconstructs far below the input-variance baseline
+    (reference example/autoencoder)."""
+    script = os.path.join(REPO, "example", "autoencoder", "train.py")
+    res = subprocess.run(
+        [sys.executable, script, "--epochs", "8"],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    m = re.search(r"RECON_MSE ([0-9.]+) baseline ([0-9.]+)",
+                  res.stdout + res.stderr)
+    assert m and float(m.group(1)) < 0.5 * float(m.group(2))
