@@ -675,13 +675,17 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
             return lax.fori_loop(0, inner, body, q)
         return loop
 
-    # true executed FLOPs per path: flash runs 9 dots (fwd 2; dq kernel
-    # recomputes p, dp then dq; dkv kernel recomputes p, dp then dk, dv),
-    # dense runs 6 (fwd 2; bwd dp, dv, dq, dk — softmax residuals saved)
+    # true executed FLOPs per path.  flash: fwd 2 dots; backward 5 when
+    # the whole K axis fits one block (the fused dqkv kernel shares the
+    # score/dp recompute — S <= 2048 with default blocks) else 7 (split
+    # dq + dkv kernels each recompute).  dense runs 6 (fwd 2; bwd dp,
+    # dv, dq, dk — softmax residuals saved).
     dot = 2 * batch * heads * seqlen * seqlen * head_dim
-    n_dots = {"flash": 9, "dense": 6}
+    fused_bwd = seqlen <= 2048
+    n_dots = {"flash": 7 if fused_bwd else 9, "dense": 6}
     out = {"bench": "attention", "shape": list(shape), "dtype": dtype,
-           "inner_iters": inner, "grads": "q,k,v"}
+           "inner_iters": inner, "grads": "q,k,v",
+           "bwd_kernel": "fused_dqkv" if fused_bwd else "split"}
     for name, fn in (("flash", flash_attention), ("dense", dense)):
         try:
             loop = mk_loop(fn)

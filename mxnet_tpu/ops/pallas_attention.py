@@ -413,6 +413,29 @@ def _bwd_unpack(rest, has_lens, has_seg):
     return lens_ref, qseg_ref, kseg_ref, rest
 
 
+def _bwd_core(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qseg_ref,
+              kseg_ref, has_seg, use_mask, qi, ki, scale, causal,
+              block_q, block_k, seq_k, kvlen):
+    """Shared recompute for all backward kernels: block reads, the
+    transposed probability block pᵀ, and dsᵀ = pᵀ∘(dpᵀ − δ)·scale.
+    Returns (q, k, v, do, pT, dsT)."""
+    q = q_ref[...].reshape(block_q, q_ref.shape[-1])
+    k = k_ref[...].reshape(block_k, k_ref.shape[-1])
+    v = v_ref[...].reshape(block_k, v_ref.shape[-1])
+    do = do_ref[...].reshape(block_q, do_ref.shape[-1])
+    lse_row = lse_ref[...].reshape(1, block_q)
+    dlt_row = dlt_ref[...].reshape(1, block_q)
+    pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
+                   seq_k, causal, kvlen=kvlen,
+                   qseg_row=qseg_ref[0] if has_seg else None,
+                   kseg_col=kseg_ref[0] if has_seg else None,
+                   use_mask=use_mask)
+    dpT = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    dsT = pT * (dpT - dlt_row) * scale          # (block_k, block_q)
+    return q, k, v, do, pT, dsT
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
                scale, causal, block_q, block_k, seq_k, seq_k_padded, n_k,
                has_lens, has_seg, pid_off=0):
@@ -461,6 +484,69 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
     def _finalize():
         dq_ref[...] = acc_ref[...].astype(dq_ref.dtype).reshape(
             dq_ref.shape)
+
+
+def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                       *rest, scale, causal, block_q, block_k, seq_k,
+                       seq_k_padded, n_q, has_lens, has_seg, pid_off=0):
+    """Single-K-block backward (n_k == 1): the score/dp recompute is
+    shared, so the whole backward is 5 dots (s, dv, dp, dq, dk) instead
+    of the split kernels' 7.  Grid (BH, n_q) sequential over q blocks:
+    dq writes per-block, dk/dv accumulate in VMEM scratch."""
+    import jax.experimental.pallas as pl
+
+    lens_ref, qseg_ref, kseg_ref, rest = _bwd_unpack(rest, has_lens, has_seg)
+    dq_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+
+    qi = pl.program_id(1 + pid_off)
+    ki = 0
+    kvlen = lens_ref[pl.program_id(0), 0] if has_lens else None
+    needs_tail = seq_k != seq_k_padded
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute(use_mask):
+        q = q_ref[...].reshape(block_q, q_ref.shape[-1])
+        k = k_ref[...].reshape(block_k, k_ref.shape[-1])
+        v = v_ref[...].reshape(block_k, v_ref.shape[-1])
+        do = do_ref[...].reshape(block_q, do_ref.shape[-1])
+        lse_row = lse_ref[...].reshape(1, block_q)
+        dlt_row = dlt_ref[...].reshape(1, block_q)
+        pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
+                       seq_k, causal, kvlen=kvlen,
+                       qseg_row=qseg_ref[0] if has_seg else None,
+                       kseg_col=kseg_ref[0] if has_seg else None,
+                       use_mask=use_mask)
+        dv_acc[...] += lax.dot_general(
+            pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dpT = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        dsT = pT * (dpT - dlt_row) * scale
+        dq_ref[...] = lax.dot_general(
+            dsT.astype(q.dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(
+                dq_ref.dtype).reshape(dq_ref.shape)
+        dk_acc[...] += lax.dot_general(
+            dsT.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # run stays True: every q block must execute (a skipped block would
+    # leave its dq output unwritten); masked rows contribute exact zeros
+    # through pT == 0.  The ladder still specializes causal full-blocks
+    # to the mask-free path.
+    _run_mask_specialized(pl, _compute, True, qi, ki, block_q, block_k,
+                          causal, has_lens, has_seg, needs_tail)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype).reshape(
+            dk_ref.shape)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype).reshape(
+            dv_ref.shape)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
@@ -534,6 +620,14 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
     scale = scale if scale is not None else D ** -0.5
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
+    if Tk <= block_k:
+        # fused dqkv path (see below): its two live (block_k, block_q)
+        # fp32 score temporaries dominate VMEM — clamp block_q (to a
+        # power of two, keeping the padding tidy) so they stay inside
+        # the ~16 MB scoped budget with headroom
+        max_bq = max(8, (10 * 1024 * 1024) // (2 * 4 * block_k))
+        pow2 = 1 << (max_bq.bit_length() - 1)
+        block_q = min(block_q, pow2)
 
     # delta = rowsum(dO ∘ O) — one cheap fused elementwise+reduce pass
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -561,6 +655,55 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_k=Tk, seq_k_padded=Tkp,
                   has_lens=lens is not None, has_seg=qs_row is not None)
+
+    if n_k == 1:
+        # single-K-block fast path: ONE fused kernel recomputes the
+        # score/dp pair once and emits dq, dk, dv together — 5 dots
+        # instead of the split kernels' 7 (both the S=2048 bench shape
+        # and BERT's S=512 land here with the default block_k=2048)
+        fused_extra, fused_especs = [], []
+        if lens is not None:
+            fused_extra.append(lens)
+            fused_especs.append(pl.BlockSpec(
+                lens.shape, lambda b, qi: (0, 0),
+                memory_space=pltpu.SMEM))
+        if qs_row is not None:
+            fused_extra += [qs_row, ks_col]
+            fused_especs += [
+                pl.BlockSpec((1, 1, block_q), lambda b, qi: (b, 0, qi)),
+                pl.BlockSpec((1, block_k, 1), lambda b, qi: (b, 0, 0)),
+            ]
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dqkv_fused_kernel, n_q=n_q, **common),
+            grid=(B * H, n_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, Dp), lambda b, qi: (b, qi, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, qi: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, qi: (b, 0, 0)),
+                pl.BlockSpec((1, block_q, Dp), lambda b, qi: (b, qi, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, qi: (b, 0, qi)),
+                pl.BlockSpec((1, 1, block_q), lambda b, qi: (b, 0, qi)),
+            ] + fused_especs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, Dp), lambda b, qi: (b, qi, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, qi: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, qi: (b, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, Tqp, Dp), q.dtype),
+                jax.ShapeDtypeStruct((B * H, Tkp, Dp), k.dtype),
+                jax.ShapeDtypeStruct((B * H, Tkp, Dp), v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_k, Dp), jnp.float32),
+                            pltpu.VMEM((block_k, Dp), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(qp, kp, vp, dop, lsep, dltp, *fused_extra)
+        dq = dq.reshape(B, H, Tqp, Dp)[:, :, :Tq, :D]
+        dk = dk.reshape(B, H, Tkp, Dp)[:, :, :Tk, :D]
+        dv = dv.reshape(B, H, Tkp, Dp)[:, :, :Tk, :D]
+        return dq, dk, dv
 
     def extra_for(kv_idx, q_idx):
         # kv_idx/q_idx map grid coords -> (k-block index, q-block index)

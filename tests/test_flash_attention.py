@@ -324,3 +324,33 @@ def test_flash_attention_bshd_fallback_grads_match_dense():
     g2 = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         assert float(jnp.max(jnp.abs(a - b))) < 2e-4
+
+
+@pytest.mark.parametrize("config", [
+    {}, {"causal": True}, {"lens": (100, 128)},
+    {"segs": True}, {"causal": True, "lens": (100, 128)},
+])
+def test_fused_single_kblock_bwd_matches_split(config):
+    """When the whole K axis fits one block the backward runs the fused
+    dqkv kernel (5 dots, shared score/dp recompute); it must match the
+    split dq+dkv kernels bit-for-fp32-bit across every mask config."""
+    B, H, T, D = 2, 3, 128, 64
+    q, k, v, do = (_rand((B, H, T, D), i) for i in range(4))
+    causal = config.get("causal", False)
+    kl = jnp.asarray(config["lens"], jnp.int32) if "lens" in config \
+        else None
+    segs = jnp.asarray(
+        onp.repeat(onp.arange(4), 32)[None].repeat(B, 0), jnp.int32) \
+        if config.get("segs") else None
+    kw = dict(causal=causal, kv_lens=kl, q_segments=segs,
+              kv_segments=segs, interpret=True, block_q=64)
+    o1, l1 = P.pallas_flash_attention(q, k, v, return_lse=True,
+                                      block_k=128, **kw)
+    g_fused = P.pallas_flash_attention_bwd(q, k, v, o1, l1, do,
+                                           block_k=128, **kw)   # n_k=1
+    o2, l2 = P.pallas_flash_attention(q, k, v, return_lse=True,
+                                      block_k=64, **kw)
+    g_split = P.pallas_flash_attention_bwd(q, k, v, o2, l2, do,
+                                           block_k=64, **kw)    # n_k=2
+    for a, b in zip(g_fused, g_split):
+        assert float(jnp.max(jnp.abs(a - b))) < 2e-5
