@@ -323,17 +323,20 @@ def bench_lstm_lm(batch_size=32, bptt=35, hidden=650, layers=2,
 
 
 def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
-                         iters=8, train_model="resnet50_v1"):
-    """Native .rec input pipeline throughput (reference: the OMP pipeline
-    in src/io/iter_image_recordio_2.cc:880) and the end-to-end
-    rec->device->train-step rate, the --data-train counterpart of the
-    synthetic --benchmark numbers."""
+                         iters=8, train_model="resnet50_v1",
+                         workers_sweep=(1, 2, 4, 8), depth_sweep=(2, 4)):
+    """Native .rec input pipeline (reference: the OMP pipeline in
+    src/io/iter_image_recordio_2.cc:880) swept over decode workers x
+    prefetch depth x wire format, plus the OVERLAPPED end-to-end
+    rec->device->train-step rate — the --data-train counterpart of the
+    synthetic --benchmark numbers.  Every stage's rate ships in the
+    artifact so BENCH rounds can see WHICH leg bounds the pipeline
+    (``pipeline_min_stage``) and track ``end_to_end_vs_train_step``."""
     import os
     import tempfile
     import numpy as onp
-    import mxnet_tpu as mx
-    from mxnet_tpu import recordio
     from mxnet_tpu.io.image_record_iter import ImageRecordIter
+    from mxnet_tpu import recordio
 
     import shutil
     d = tempfile.mkdtemp(prefix="benchrec")
@@ -349,59 +352,83 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
                                            img_fmt=".jpg"))
     rec.close()
 
-    def fresh_iter():
+    def fresh_iter(workers=8, u8=True):
         return ImageRecordIter(
             path_imgrec=rec_path, data_shape=(3, image_size, image_size),
             batch_size=batch_size, shuffle=True, rand_crop=True,
             rand_mirror=True, mean_r=123.68, mean_g=116.78, mean_b=103.94,
-            std_r=58.4, std_g=57.12, std_b=57.38, preprocess_threads=8)
+            std_r=58.4, std_g=57.12, std_b=57.38,
+            preprocess_threads=workers, u8_output=u8)
 
-    # (a) rec -> host batch rate (decode + augment in the C++ pool)
-    it = fresh_iter()
-    for batch in it:       # warm epoch (page cache + thread pool spin-up)
-        pass
-    n = 0
-    t0 = time.perf_counter()
-    for _ in range(3):
+    # (a) decode scaling: rec -> host batch rate (decode + augment in the
+    # C++ pool, zero-copy borrow delivery) per worker count.  u8 output —
+    # the production wire format — so this is pure decode+augment work.
+    def decode_epoch(it):
+        n = 0
+        while True:
+            try:
+                _, _, pad, release = it.next_borrow()
+            except StopIteration:
+                break
+            release()
+            n += batch_size - pad
         it.reset()
-        for batch in it:
-            n += batch.data[0].shape[0]
-    host_rate = n / (time.perf_counter() - t0)
+        return n
 
-    # (b) steady-state wire leg: uint8 batches (4x smaller than f32),
-    # double-buffered async device_put, on-device normalize — one full
-    # epoch, syncing each delivered device batch
+    decode_rates = {}
+    for w in workers_sweep:
+        it = fresh_iter(workers=w)
+        decode_epoch(it)   # warm (page cache + pool spin-up), per config
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(2):
+            n += decode_epoch(it)
+        decode_rates[str(w)] = round(n / (time.perf_counter() - t0), 1)
+        it.close()
+    host_rate = max(decode_rates.values())
+    best_workers = int(max(decode_rates, key=lambda k: decode_rates[k]))
+    scaling = (round(decode_rates["4"] / decode_rates["1"], 2)
+               if decode_rates.get("1") and decode_rates.get("4") else None)
+
+    # (b) device-feed sweep: depth-K async device_put from the feeder
+    # thread + pre-jitted on-device normalize, per (wire format, depth).
+    # One epoch each, first batch (compile + its transfer) excluded.
     import jax
     from mxnet_tpu.io import DevicePrefetchIter
-
-    def fresh_u8_iter():
-        return ImageRecordIter(
-            path_imgrec=rec_path, data_shape=(3, image_size, image_size),
-            batch_size=batch_size, shuffle=True, rand_crop=True,
-            rand_mirror=True, mean_r=123.68, mean_g=116.78, mean_b=103.94,
-            std_r=58.4, std_g=57.12, std_b=57.38, preprocess_threads=8,
-            u8_output=True)
 
     def _sync_scalar(nd):
         # one-element D2H sync: a full asnumpy() would drag the whole
         # batch back through the ~5 MB/s tunnel inside the timed window
         return float(onp.asarray(nd[0, 0, 0, 0].asnumpy()))
 
-    feed = DevicePrefetchIter(fresh_u8_iter(), dtype="bfloat16")
-    n = 0
-    last = None
-    t0 = None
-    for batch in feed:
-        if t0 is None:  # exclude normalize-jit compile from the steady rate
-            _sync_scalar(batch.data[0])
-            t0 = time.perf_counter()
-            continue
-        n += batch.data[0].shape[0]
-        last = batch.data[0]
-    if last is not None:
-        _sync_scalar(last)  # one sync: transfers pipeline, like a real feed
-    wire_rate = n / (time.perf_counter() - t0) if n else 0.0
-    feed.close()
+    def feed_epoch_rate(feed):
+        n = 0
+        last = None
+        t0 = None
+        for batch in feed:
+            if t0 is None:  # exclude compile + first transfer
+                _sync_scalar(batch.data[0])
+                t0 = time.perf_counter()
+                continue
+            n += batch.data[0].shape[0]
+            last = batch.data[0]
+        if last is not None:
+            _sync_scalar(last)  # one sync: transfers pipeline, real-feed style
+        return n / (time.perf_counter() - t0) if n else 0.0
+
+    feed_sweep = []
+    for wire in ("uint8", "float32"):
+        for depth in depth_sweep:
+            feed = DevicePrefetchIter(
+                fresh_iter(workers=best_workers, u8=(wire == "uint8")),
+                dtype="bfloat16", depth=depth)
+            rate = feed_epoch_rate(feed)
+            feed.close()
+            feed_sweep.append({"wire": wire, "depth": depth,
+                               "img_s": round(rate, 1)})
+    u8_feeds = [f for f in feed_sweep if f["wire"] == "uint8"]
+    best_feed = max(u8_feeds, key=lambda f: f["img_s"])
+    wire_rate = best_feed["img_s"]
 
     # (c) the train step itself (synthetic on-device data)
     step, data, label = _build_train_step(train_model, batch_size,
@@ -411,11 +438,13 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
                                warmup=3, iters=max(4, iters))
     step_rate = batch_size / step_s
 
-    # (d) OVERLAPPED end-to-end: .rec -> per-image-parallel decode -> u8
-    # wire (double-buffered) -> on-device normalize -> train step, one
-    # epoch, one sync at the end — every leg runs concurrently, so this
-    # is the sustained trainable rate, not a one-shot probe
-    feed = DevicePrefetchIter(fresh_u8_iter(), dtype="bfloat16")
+    # (d) OVERLAPPED end-to-end: .rec -> multi-worker decode (borrowed
+    # slots) -> u8 wire, device_put issued depth-K ahead from the feeder
+    # thread -> pre-jitted on-device normalize -> train step; one epoch,
+    # one sync at the end — every leg runs concurrently, so this is the
+    # sustained trainable rate, not a one-shot probe
+    feed = DevicePrefetchIter(fresh_iter(workers=best_workers),
+                              dtype="bfloat16", depth=best_feed["depth"])
     loss = None
     n = 0
     t0 = None
@@ -432,26 +461,34 @@ def bench_input_pipeline(batch_size=128, n_images=512, image_size=224,
     feed.close()
 
     shutil.rmtree(d, ignore_errors=True)
-    # Sustained throughput is the slowest overlapped leg.  NOTE: this dev
-    # environment has ONE host CPU core (decode is serial no matter the
-    # thread count) and the device sits behind a ~5 MB/s network tunnel
-    # (the wire leg measures tunnel bandwidth, not PCIe) — on a real TPU
-    # host both legs scale: decode ~linearly in cores (per-image work
-    # stealing), wire is local DMA.  The honest host-side roofline ships
-    # in the artifact: decode_cores and the per-core decode rate.
-    # the pool runs preprocess_threads=8 workers, so at most
-    # min(cores, 8) cores can be decoding — the honest per-core divisor
-    cores = min(os.cpu_count() or 1, 8)
+    # Sustained throughput is the slowest overlapped leg; name it so the
+    # next optimization round aims at the right stage.  NOTE: on a
+    # 1-core dev host decode cannot scale regardless of worker count,
+    # and a tunneled device makes the wire leg measure tunnel bandwidth,
+    # not PCIe — decode_workers and the per-core rate ship so the reader
+    # can roofline the host either way.
+    cores = min(os.cpu_count() or 1, max(workers_sweep))
+    # per-core divisor: the worker count that PRODUCED host_rate (capped
+    # by physical cores), not the sweep maximum — dividing the 4-worker
+    # rate by 8 cores would understate per-core decode 2x
+    per_core_div = max(1, min(best_workers, os.cpu_count() or 1))
+    stages = {"decode": host_rate, "device_feed": wire_rate,
+              "train_step": step_rate}
     return {"bench": "input_pipeline", "batch_size": batch_size,
             "n_images": n_images, "image_size": image_size,
             "wire_format": "uint8+device_normalize",
             "decode_cores": cores,
+            "decode_workers": decode_rates,
+            "decode_scaling_1_to_4": scaling,
+            "feed_sweep": feed_sweep,
+            "prefetch_depth": best_feed["depth"],
             "rec_to_host_img_s": round(host_rate, 1),
-            "rec_to_host_img_s_per_core": round(host_rate / cores, 1),
+            "rec_to_host_img_s_per_core": round(host_rate / per_core_div, 1),
             "device_feed_img_s": round(wire_rate, 1),
             "train_step_img_s": round(step_rate, 1),
             "end_to_end_img_s": round(e2e_rate, 1),
-            "end_to_end_vs_train_step": round(e2e_rate / step_rate, 3)}
+            "end_to_end_vs_train_step": round(e2e_rate / step_rate, 3),
+            "pipeline_min_stage": min(stages, key=lambda k: stages[k])}
 
 
 def bench_input_pipeline_isolated():

@@ -39,9 +39,15 @@ class _FusedUpdate:
     ``save_states``/``load_states`` serialize exactly what this path
     updates.
 
-    Falls back (returns False) when the optimizer has no pure ``make_step``,
-    uses multi-precision master weights, or holds non-NDArray state — the
-    caller then runs the eager per-parameter loop.
+    Multi-precision runs IN the fused program (reference ``mp_sgd`` /
+    ``mp_adam`` kernels): for half-width weights under
+    ``optimizer.multi_precision`` the fp32 master rides as state leaf 0 —
+    the update applies to the master in fp32 and the working weight is
+    re-quantized from it each step, all inside the same jitted call.
+
+    Falls back (returns False) only when the optimizer has no pure
+    ``make_step``, holds non-NDArray state, or a gradient is parts-backed
+    row-sparse — the caller then runs the eager per-parameter loop.
     """
 
     def __init__(self, updater):

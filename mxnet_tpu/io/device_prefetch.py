@@ -1,86 +1,216 @@
-"""Double-buffered host->device prefetch over any DataIter.
+"""Depth-K asynchronous host->device prefetch over any DataIter.
 
 TPU-native counterpart of the reference's ``PrefetchingIter`` +
 per-GPU ``_load_data`` scatter (``python/mxnet/io/io.py`` PrefetchingIter,
-``executor_group.py:451``): while the consumer works on batch N, batch
-N+1's host buffers are already in flight to the device — ``jax.device_put``
-is asynchronous, so issuing it one batch ahead overlaps the transfer with
-both host decode and device compute.
+``executor_group.py:451``), rebuilt as a real pipeline stage: a background
+feeder thread pulls host batches and issues ``jax.device_put`` up to
+``depth`` batches ahead into a bounded queue — the device-side ring.  By
+the time the consumer asks for batch N, its transfer (and, in uint8 wire
+mode, its on-device normalize) was dispatched while batches N-1..N-depth
+were being computed, so the host->device leg overlaps BOTH host decode and
+device compute instead of running between them.
 
-With a uint8 wire format (``ImageRecordIter(u8_output=True)``) the
-transfer moves 4x fewer bytes than normalized float32 and the
-``(x - mean) / std`` normalize runs on-device in a tiny jitted kernel
-(fused by XLA into the consumer when possible) — the right split for any
-bandwidth-constrained host->device link.
+Wire formats:
+
+* ``uint8`` (``ImageRecordIter(u8_output=True)``): raw pixels move 4x
+  fewer bytes than normalized float32 and ``(x - mean) / std`` runs
+  on-device in ONE jitted kernel built at construction — never
+  re-traced per batch, fused by XLA into the consumer when possible.
+  The right split for any bandwidth-constrained host->device link.
+* ``float32``: the host-normalized batch ships as-is and is cast to
+  ``dtype`` on-device (also a single pre-built jit).
+
+Placement composes with SPMD training: pass ``mesh=`` (or an explicit
+``sharding=``) and every batch is laid out as ``NamedSharding(mesh,
+P(axis, None, ...))`` — per-replica shards land directly on their target
+devices, so ``DataParallelStep`` sees pre-placed operands and skips its
+own scatter.
+
+Host buffers are staged through a small ring of reusable arrays (sized
+``depth + 2``) on accelerator backends, and the native iterator's
+``next_borrow`` zero-copy path is used when available — decode slots go
+straight to the staging copy with no intermediate allocation.
 """
 from __future__ import annotations
 
+import queue
+import threading
+import weakref
+
 import numpy as onp
 
-from .io import DataBatch, DataIter
+from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["DevicePrefetchIter"]
 
+_BATCH, _END, _ERR = 0, 1, 2
+
 
 class DevicePrefetchIter(DataIter):
-    """Wrap ``base`` so batches arrive as device-resident NDArrays.
+    """Wrap ``base`` so batches arrive device-resident, ``depth`` ahead.
 
-    ``dtype`` is the on-device data dtype (labels stay float32).  When the
-    base iterator yields uint8 batches (``u8_output`` mode), ``mean`` and
-    ``std`` (defaulted from the base iterator's attributes) are applied
-    on-device after the cast.
+    Parameters
+    ----------
+    base : DataIter
+        Source of host batches.  Iterators exposing ``next_host`` /
+        ``next_borrow`` (the native ``ImageRecordIter``) feed raw numpy
+        straight through; anything else is unwrapped from its DataBatch.
+    dtype : str, default "bfloat16"
+        On-device data dtype (labels stay float32).
+    mean, std : array-like, optional
+        Per-channel normalize constants for uint8 wire batches, defaulted
+        from the base iterator's attributes.
+    device : jax.Device, optional
+        Single-device placement target (default ``jax.devices()[0]``).
+    depth : int, default 2
+        Number of batches kept in flight ahead of the consumer.
+    mesh : jax.sharding.Mesh, optional
+        Place every batch sharded over ``axis`` of this mesh instead of
+        on one device (per-replica shards go straight to their devices).
+    axis : str, default "dp"
+        Mesh axis the leading (batch) dimension is sharded over.
+    sharding : jax.sharding.Sharding, optional
+        Explicit placement for the DATA array (overrides device/mesh);
+        labels use the analogous leading-axis sharding.
     """
 
     def __init__(self, base, dtype="bfloat16", mean=None, std=None,
-                 device=None):
+                 device=None, depth=2, mesh=None, axis="dp", sharding=None):
         super().__init__(getattr(base, "batch_size", 0))
         import jax
 
         self._base = base
         self._dtype = dtype
+        self._depth = max(1, int(depth))
         self._device = device or jax.devices()[0]
+        self._mesh = mesh
+        self._axis = axis
+        self._sharding = sharding
         mean = mean if mean is not None else getattr(base, "mean", None)
         std = std if std is not None else getattr(base, "std", None)
         self._mean = None if mean is None else onp.asarray(mean, "float32")
         self._std = None if std is None else onp.asarray(std, "float32")
-        self._norm_fn = None
-        self._pending = None
+        self._norm_fn = self._build_norm()
+        self._cast_fn = None
+        # host staging ring (reused on accelerator backends; the CPU
+        # backend may alias numpy memory into jax arrays, so there every
+        # stage is a fresh copy).  Each slot carries the device arrays
+        # its last transfer produced: reuse blocks on them first, so a
+        # buffer is never rewritten under an in-flight device_put.
+        self._ring = [None] * (self._depth + 2)
+        self._ring_guard = [None] * (self._depth + 2)
+        self._ring_i = 0
+        self._stage_idx = None
+        self._reuse_host = self._device.platform != "cpu"
+        self._q = None
+        self._stop = threading.Event()
+        self._thread = None
         self._exhausted = False
+        # GC safety net: a dropped iterator must not leave a feeder
+        # thread blocked on the queue.  The holder (not ``self`` — the
+        # finalizer must hold no strong reference to it) names the live
+        # thread; the feeder itself only touches ``self`` through a
+        # weakref between blocking points, so GC of the iterator fires
+        # this and the thread unwinds.
+        self._holder = {"thread": None}
+        self._finalizer = weakref.finalize(
+            self, DevicePrefetchIter._shutdown_thread,
+            self._stop, self._holder)
+        self._start_feeder()
 
-    @property
-    def provide_data(self):
-        return self._base.provide_data
-
-    @property
-    def provide_label(self):
-        return self._base.provide_label
-
-    def _normalize(self, dev_arr):
-        """On-device (x - mean) / std for u8 wire batches."""
+    # ------------------------------------------------------------------
+    # construction-time jits (one trace each, donated input buffers)
+    # ------------------------------------------------------------------
+    def _build_norm(self):
         import jax
         import jax.numpy as jnp
 
-        if self._norm_fn is None:
-            mean = jnp.zeros((3,), jnp.float32) if self._mean is None \
-                else jnp.asarray(self._mean)
-            std = jnp.ones((3,), jnp.float32) if self._std is None \
-                else jnp.asarray(self._std)
+        mean = jnp.zeros((3,), jnp.float32) if self._mean is None \
+            else jnp.asarray(self._mean)
+        std = jnp.ones((3,), jnp.float32) if self._std is None \
+            else jnp.asarray(self._std)
+        dt = jnp.dtype(self._dtype)
+
+        def norm(x):
+            xf = x.astype(jnp.float32)
+            y = (xf - mean.reshape(1, -1, 1, 1)) / std.reshape(1, -1, 1, 1)
+            return y.astype(dt)
+
+        # no donate: the u8 input and the widened output differ in byte
+        # size, so XLA could never reuse the buffer anyway
+        return jax.jit(norm)
+
+    def _cast(self, dev):
+        import jax
+        import jax.numpy as jnp
+        if str(dev.dtype) == str(jnp.dtype(self._dtype)):
+            return dev
+        if self._cast_fn is None:
             dt = jnp.dtype(self._dtype)
+            self._cast_fn = jax.jit(lambda x: x.astype(dt))
+        return self._cast_fn(dev)
 
-            @jax.jit
-            def norm(x):
-                xf = x.astype(jnp.float32)
-                y = (xf - mean.reshape(1, -1, 1, 1)) \
-                    / std.reshape(1, -1, 1, 1)
-                return y.astype(dt)
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+    def _target(self, ndim):
+        """Placement for an ndim-dimensional batch array."""
+        if self._sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            s = self._sharding
+            if isinstance(s, NamedSharding) and len(s.spec) != ndim:
+                # rank-adapt for labels / non-4D batches: keep the
+                # leading (batch) axis placement, replicate the rest
+                lead = s.spec[0] if len(s.spec) else None
+                return NamedSharding(
+                    s.mesh, PartitionSpec(lead, *([None] * (ndim - 1))))
+            return s
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = PartitionSpec(self._axis, *([None] * (ndim - 1)))
+            return NamedSharding(self._mesh, spec)
+        return self._device
 
-            self._norm_fn = norm
-        return self._norm_fn(dev_arr)
+    # ------------------------------------------------------------------
+    # host side
+    # ------------------------------------------------------------------
+    def _stage(self, view):
+        """A stable host copy of ``view`` the transfer can own: through
+        the reusable ring off-CPU, a fresh array on the CPU backend."""
+        if not self._reuse_host:
+            self._stage_idx = None
+            return onp.array(view)
+        i = self._ring_i
+        guard = self._ring_guard[i]
+        if guard is not None:
+            # by the time the ring wraps (depth+2 batches later) this
+            # transfer is long done — the block is a cheap no-op guard
+            for a in guard:
+                a.block_until_ready()
+            self._ring_guard[i] = None
+        buf = self._ring[i]
+        if buf is None or buf.shape != view.shape or buf.dtype != view.dtype:
+            buf = onp.empty_like(view)
+            self._ring[i] = buf
+        self._ring_i = (i + 1) % len(self._ring)
+        self._stage_idx = i
+        onp.copyto(buf, view)
+        return buf
 
     def _next_host(self):
-        """(data_np, label_np, pad) from the base with the fewest copies:
-        iterators exposing ``next_host`` hand raw numpy straight through
-        (the native path); otherwise unwrap a DataBatch."""
+        """(data_np, label_np, pad) with the fewest copies: borrow the
+        native decode slot when the base supports it (zero-copy loan,
+        staged + released here), else ``next_host`` raw numpy, else
+        unwrap a DataBatch."""
+        nb = getattr(self._base, "next_borrow", None)
+        if nb is not None:
+            data_v, lab_v, pad, release = nb()
+            try:
+                data = self._stage(data_v)
+                lab = onp.array(lab_v)
+            finally:
+                release()
+            return data, lab, pad
         nh = getattr(self._base, "next_host", None)
         if nh is not None:
             return nh()
@@ -93,50 +223,151 @@ class DevicePrefetchIter(DataIter):
                 else onp.asarray(lab),
                 batch.pad)
 
+    # ------------------------------------------------------------------
+    # feeder thread
+    # ------------------------------------------------------------------
     def _ship(self, host_np, lab_np, pad):
-        """Start the async host->device transfer for one host batch."""
+        """Dispatch one batch's async host->device transfer and (u8
+        wire) its on-device normalize; runs ON THE FEEDER THREAD so the
+        per-batch dispatch latency is hidden behind the consumer."""
         import jax
-        import jax.numpy as jnp
 
+        lab_np = onp.asarray(lab_np)
+        dev, dev_lab = jax.device_put(
+            (host_np, lab_np),
+            (self._target(host_np.ndim), self._target(lab_np.ndim)))
         if host_np.dtype == onp.uint8:
-            dev = jax.device_put(host_np, self._device)      # 1 byte/px wire
+            dev = self._norm_fn(dev)
         else:
-            dev = jax.device_put(
-                jnp.asarray(host_np, jnp.dtype(self._dtype)), self._device)
-        dev_lab = jax.device_put(onp.asarray(lab_np), self._device)
-        return (dev, dev_lab, pad)
+            dev = self._cast(dev)
+        if self._stage_idx is not None:
+            # dev derives from the staged buffer's transfer: readiness of
+            # dev implies the ring slot is safe to rewrite (see _stage)
+            self._ring_guard[self._stage_idx] = (dev, dev_lab)
+            self._stage_idx = None
+        return dev, dev_lab, pad
 
-    def _finish(self, shipped):
-        from ..ndarray.ndarray import _wrap
+    @staticmethod
+    def _feed(wref, q, stop):
+        """Feeder loop.  Holds the iterator only through ``wref`` and
+        drops it before every blocking queue put, so an abandoned
+        (garbage-collected) iterator's finalizer can fire and stop the
+        thread instead of leaking it."""
+        def put(item):
+            while True:
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    if stop.is_set():
+                        return False
 
-        dev, dev_lab, pad = shipped
-        if dev.dtype == onp.uint8:
-            dev = self._normalize(dev)
-        return DataBatch([_wrap(dev)], [_wrap(dev_lab)], pad=pad)
+        while not stop.is_set():
+            it = wref()
+            if it is None:
+                return
+            try:
+                host = it._next_host()
+            except StopIteration:
+                it = None
+                put((_END, None))
+                return
+            except Exception as e:          # pragma: no cover - passthrough
+                it = None
+                put((_ERR, e))
+                return
+            if stop.is_set():               # drop the in-flight batch
+                return
+            try:
+                shipped = it._ship(*host)
+            except Exception as e:
+                it = None
+                put((_ERR, e))
+                return
+            it = None
+            if not put((_BATCH, shipped)):
+                return
+
+    def _start_feeder(self):
+        self._q = queue.Queue(maxsize=self._depth)
+        self._stop.clear()
+        self._exhausted = False
+        self._thread = threading.Thread(
+            target=DevicePrefetchIter._feed,
+            args=(weakref.ref(self), self._q, self._stop),
+            name="DevicePrefetchIter-feeder", daemon=True)
+        self._holder["thread"] = self._thread
+        self._thread.start()
+
+    @staticmethod
+    def _shutdown_thread(stop, holder):
+        stop.set()
+        t = holder.get("thread")
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def _stop_feeder(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            while t.is_alive():
+                try:                        # unblock a feeder stuck in put
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
+        self._thread = None
+        self._holder["thread"] = None
+        self._q = None
+
+    # ------------------------------------------------------------------
+    # DataIter surface
+    # ------------------------------------------------------------------
+    @property
+    def provide_data(self):
+        # report the POST-normalize dtype: that is what the consumer sees
+        # (bfloat16 resolves through ml_dtypes when jax registered it
+        # with numpy; otherwise float32 is the closest host-side truth)
+        try:
+            dt = onp.dtype(self._dtype)
+        except TypeError:
+            dt = onp.dtype("float32")
+        descs = self._base.provide_data
+        return [DataDesc(d.name, d.shape, dtype=dt) if i == 0 else d
+                for i, d in enumerate(descs)]
+
+    @property
+    def provide_label(self):
+        return self._base.provide_label
 
     def reset(self):
+        self._stop_feeder()
         self._base.reset()
-        self._pending = None
-        self._exhausted = False
+        self._start_feeder()
 
     def next(self):
-        if self._exhausted:
+        if self._exhausted or self._q is None:
             raise StopIteration
-        if self._pending is None:                  # first batch of epoch
-            try:
-                self._pending = self._ship(*self._next_host())
-            except StopIteration:
-                self._exhausted = True
-                raise
-        current = self._pending
-        self._pending = None
-        try:                                       # overlap: ship N+1 now
-            self._pending = self._ship(*self._next_host())
-        except StopIteration:
+        kind, payload = self._q.get()
+        if kind == _END:
             self._exhausted = True
-        return self._finish(current)
+            raise StopIteration
+        if kind == _ERR:
+            self._exhausted = True
+            raise payload
+        from ..ndarray.ndarray import _wrap
+        dev, dev_lab, pad = payload
+        return DataBatch([_wrap(dev)], [_wrap(dev_lab)], pad=pad)
 
     def close(self):
+        self._stop_feeder()
+        self._finalizer.detach()
         close = getattr(self._base, "close", None)
         if close:
             close()
+
+    def __del__(self):
+        try:
+            self._stop_feeder()
+        except Exception:                   # pragma: no cover
+            pass

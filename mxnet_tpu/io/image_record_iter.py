@@ -147,13 +147,34 @@ class _NativeImageRecordIter(DataIter):
             self._exhausted = True
             raise StopIteration
         data, labels, pad, errors = out
+        self._warn_errors(errors)
+        label = labels[:, 0] if self.label_width == 1 else labels
+        return data, label, pad
+
+    def next_borrow(self):
+        """Zero-copy variant of :meth:`next_host`: ``(data_view,
+        label_view, pad, release)`` where the views alias the decode
+        ring slot and stay valid only until ``release()`` is called —
+        the consumer copies (or finishes its ``device_put``) first,
+        then releases the slot back to the worker pool."""
+        if self._exhausted:
+            raise StopIteration
+        out = self._pipe.next_borrow()
+        if out is None:
+            self._exhausted = True
+            raise StopIteration
+        data, labels, pad, errors, token = out
+        self._warn_errors(errors)
+        label = labels[:, 0] if self.label_width == 1 else labels
+        return data, label, pad, lambda: self._pipe.release(token)
+
+    @staticmethod
+    def _warn_errors(errors):
         if errors:
             logging.warning(
                 "ImageRecordIter: %d undecodable records in batch "
                 "(zero image, label -1 — mask labels < 0 to exclude)",
                 errors)
-        label = labels[:, 0] if self.label_width == 1 else labels
-        return data, label, pad
 
     def next(self):
         from ..ndarray.ndarray import array

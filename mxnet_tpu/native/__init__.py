@@ -83,6 +83,14 @@ def lib():
         L.mxtpu_pipeline_next_u8.argtypes = [
             ctypes.c_void_p, u8p,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int)]
+        L.mxtpu_pipeline_borrow.restype = ctypes.c_int
+        L.mxtpu_pipeline_borrow.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_int)]
+        L.mxtpu_pipeline_release.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_void_p]
         L.mxtpu_pipeline_reset.argtypes = [ctypes.c_void_p]
         L.mxtpu_pipeline_nbatches.restype = ctypes.c_int
         L.mxtpu_pipeline_nbatches.argtypes = [ctypes.c_void_p]
@@ -187,6 +195,7 @@ class NativeImagePipeline:
         self.data_shape = data_shape
         self.label_width = label_width
         self.u8_output = bool(u8_output)
+        self._depth = max(2, int(prefetch_buffer))  # ring slots (C++ min 2)
         # kept for the consumer's on-device normalize in u8 mode
         self.mean = onp.asarray(
             mean if mean is not None else [0, 0, 0], onp.float32)
@@ -236,6 +245,51 @@ class NativeImagePipeline:
         if pad < 0:
             raise RuntimeError("native pipeline failed")
         return data, labels, pad, errs.value
+
+    def next_borrow(self):
+        """Zero-copy variant of :meth:`next`: lend the next in-order
+        batch's ring-slot buffers instead of copying them out.
+
+        Returns ``(data, labels, pad, errors, token)`` where ``data`` /
+        ``labels`` are numpy VIEWS of the slot (uint8 NCHW in
+        ``u8_output`` mode, float32 otherwise; labels float32), valid
+        only until :meth:`release`\\ (token) — release invalidates them
+        and returns the slot to the decode workers.  Up to
+        ``prefetch_buffer`` loans may be outstanding; each one shrinks
+        the ring the workers can fill, so a consumer holding K batches
+        in flight should size ``prefetch_buffer > K``.  Returns ``None``
+        when the epoch is exhausted."""
+        c, h, w = self.data_shape
+        token = ctypes.c_void_p()
+        dptr = ctypes.c_void_p()
+        lptr = ctypes.POINTER(ctypes.c_float)()
+        errs = ctypes.c_int()
+        pad = self._lib.mxtpu_pipeline_borrow(
+            self._h, ctypes.byref(token), ctypes.byref(dptr),
+            ctypes.byref(lptr), ctypes.byref(errs))
+        if pad == -1:
+            return None
+        if pad == -3:
+            raise RuntimeError(
+                "all %d ring slots are borrowed — release one first or "
+                "create the pipeline with a larger prefetch_buffer"
+                % self._depth)
+        if pad < 0:
+            raise RuntimeError("native pipeline failed")
+        shape = (self.batch_size, c, h, w)
+        if self.u8_output:
+            data = onp.ctypeslib.as_array(
+                ctypes.cast(dptr, ctypes.POINTER(ctypes.c_uint8)), shape)
+        else:
+            data = onp.ctypeslib.as_array(
+                ctypes.cast(dptr, ctypes.POINTER(ctypes.c_float)), shape)
+        labels = onp.ctypeslib.as_array(
+            lptr, (self.batch_size, self.label_width))
+        return data, labels, pad, errs.value, token
+
+    def release(self, token):
+        """Return a :meth:`next_borrow` slot to the ring (views die)."""
+        self._lib.mxtpu_pipeline_release(self._h, token)
 
     def reset(self):
         self._lib.mxtpu_pipeline_reset(self._h)

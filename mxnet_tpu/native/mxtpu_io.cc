@@ -205,6 +205,14 @@ void resize_bilinear(const uint8_t* src, int sh, int sw, uint8_t* dst, int dh,
 // Per-record augment + normalize into an NCHW float32 slab
 // ---------------------------------------------------------------------------
 
+// Decode/resize staging buffers are thread_local: a 224px JPEG decodes
+// through ~1 MB of scratch, and per-record malloc/free of that much
+// memory (plus the page faults on first touch) costs a measurable slice
+// of the per-image budget once N workers decode concurrently.  Capacity
+// persists across records, so steady state is allocation-free.
+thread_local std::vector<uint8_t> tls_img;
+thread_local std::vector<uint8_t> tls_tmp;
+
 struct AugParams {
   int out_h, out_w;
   int resize_short;   // 0 = off
@@ -218,7 +226,7 @@ struct AugParams {
 // per ap.u8_out.
 void process_record(const uint8_t* jpg, uint64_t len, const AugParams& ap,
                     float* outf, uint8_t* outu, std::mt19937* rng, bool* ok) {
-  std::vector<uint8_t> img;
+  std::vector<uint8_t>& img = tls_img;
   int h = 0, w = 0;
   if (!jpeg_decode(jpg, len, &img, &h, &w)) {
     const uint64_t n = uint64_t(3) * ap.out_h * ap.out_w;
@@ -231,7 +239,7 @@ void process_record(const uint8_t* jpg, uint64_t len, const AugParams& ap,
   }
   *ok = true;
   // resize shorter side, then guarantee the crop fits
-  std::vector<uint8_t> tmp;
+  std::vector<uint8_t>& tmp = tls_tmp;
   if (ap.resize_short > 0 && std::min(h, w) != ap.resize_short) {
     int nh, nw;
     if (h < w) {
@@ -313,6 +321,8 @@ struct Pipeline {
   std::vector<uint32_t> order;     // shuffled view of [0, n)
   AugParams aug;
   int batch = 0, label_width = 1, nthreads = 1, depth = 2;
+  int stripe = 1;   // images claimed per lock acquisition (index shard)
+  int borrowed = 0; // slots lent to the consumer via borrow(), guarded by mu
   int shuffle = 0;
   uint64_t seed = 0;
   int epoch = 0;
@@ -356,7 +366,7 @@ void worker_loop(Pipeline* p) {
   const uint64_t per_img = uint64_t(3) * p->aug.out_h * p->aug.out_w;
   for (;;) {
     Active* act = nullptr;
-    int i = -1;
+    int i0 = -1, take = 0;
     {
       std::unique_lock<std::mutex> lk(p->mu);
       p->cv_work.wait(lk, [&] {
@@ -381,58 +391,73 @@ void worker_loop(Pipeline* p) {
         // more images than one just became claimable
         p->cv_work.notify_all();
       }
-      i = act->img_next++;
+      // claim a contiguous STRIPE of the batch's record indices (the
+      // worker's shard of the index for this acquisition) — one lock
+      // round-trip amortized over `stripe` decodes, still in-order and
+      // schedule-independent because augmentation RNG is keyed on the
+      // record position, never on the claiming thread
+      i0 = act->img_next;
+      take = std::min(p->stripe, p->batch - i0);
+      act->img_next += take;
       p->busy++;
     }
     Batch* slot = act->slot;
     int bidx = act->bidx;
-    // deterministic per-record RNG: (seed, epoch, record position) —
-    // output is identical for any thread count / schedule
     int n = int(p->order.size());
-    int64_t pos = int64_t(bidx) * p->batch + i;
-    bool wrapped = pos >= n;
-    if (wrapped) pos %= n;  // wrap: reference round_batch padding
-    uint32_t rec = p->order[pos];
-    std::mt19937 rng(uint32_t(p->seed * 1315423911u + p->epoch * 2654435761u +
-                              uint32_t(bidx * p->batch + i)));
-    const uint8_t* data;
-    uint64_t len;
-    IRView ir;
-    bool ok = rec_at(p->file, p->offsets[rec], &data, &len) &&
-              ir_parse(data, len, &ir);
-    float* outf = p->aug.u8_out ? nullptr
-                                : slot->data.data() + uint64_t(i) * per_img;
-    uint8_t* outu = p->aug.u8_out
-                        ? slot->data_u8.data() + uint64_t(i) * per_img
-                        : nullptr;
-    float* lab = slot->labels.data() + uint64_t(i) * p->label_width;
-    bool err = false;
-    // corrupt/undecodable records are zero-filled with label -1 so the
-    // consumer can mask them out; 0 would silently train as class 0
-    if (!ok) {
-      if (p->aug.u8_out)
-        std::fill(outu, outu + per_img, uint8_t(0));
-      else
-        std::fill(outf, outf + per_img, 0.f);
-      std::fill(lab, lab + p->label_width, -1.f);
-      err = true;
-    } else {
-      for (int l = 0; l < p->label_width; ++l)
-        lab[l] = ir.labels ? (l < int(ir.flag) ? ir.labels[l] : 0.f)
-                           : (l == 0 ? ir.label : 0.f);
-      bool dec_ok;
-      process_record(ir.img, ir.img_len, p->aug, outf, outu, &rng, &dec_ok);
-      if (!dec_ok) {
+    int n_err = 0, n_wrap = 0;
+    for (int i = i0; i < i0 + take; ++i) {
+      // deterministic per-record RNG: (seed, epoch, record position) —
+      // output is identical for any thread count / schedule
+      int64_t pos = int64_t(bidx) * p->batch + i;
+      bool wrapped = pos >= n;
+      if (wrapped) pos %= n;  // wrap: reference round_batch padding
+      uint32_t rec = p->order[pos];
+      std::mt19937 rng(uint32_t(p->seed * 1315423911u +
+                                p->epoch * 2654435761u +
+                                uint32_t(bidx * p->batch + i)));
+      const uint8_t* data;
+      uint64_t len;
+      IRView ir;
+      bool ok = rec_at(p->file, p->offsets[rec], &data, &len) &&
+                ir_parse(data, len, &ir);
+      float* outf = p->aug.u8_out ? nullptr
+                                  : slot->data.data() + uint64_t(i) * per_img;
+      uint8_t* outu = p->aug.u8_out
+                          ? slot->data_u8.data() + uint64_t(i) * per_img
+                          : nullptr;
+      float* lab = slot->labels.data() + uint64_t(i) * p->label_width;
+      bool err = false;
+      // corrupt/undecodable records are zero-filled with label -1 so the
+      // consumer can mask them out; 0 would silently train as class 0
+      if (!ok) {
+        if (p->aug.u8_out)
+          std::fill(outu, outu + per_img, uint8_t(0));
+        else
+          std::fill(outf, outf + per_img, 0.f);
         std::fill(lab, lab + p->label_width, -1.f);
         err = true;
+      } else {
+        for (int l = 0; l < p->label_width; ++l)
+          lab[l] = ir.labels ? (l < int(ir.flag) ? ir.labels[l] : 0.f)
+                             : (l == 0 ? ir.label : 0.f);
+        bool dec_ok;
+        process_record(ir.img, ir.img_len, p->aug, outf, outu, &rng,
+                       &dec_ok);
+        if (!dec_ok) {
+          std::fill(lab, lab + p->label_width, -1.f);
+          err = true;
+        }
       }
+      if (err) n_err++;
+      if (wrapped) n_wrap++;
     }
     {
       std::lock_guard<std::mutex> lk(p->mu);
       p->busy--;
-      if (err) slot->errors++;
-      if (wrapped) slot->pad++;
-      if (--act->remaining == 0) {
+      slot->errors += n_err;
+      slot->pad += n_wrap;
+      act->remaining -= take;
+      if (act->remaining == 0) {
         p->completed[bidx] = slot;
         p->actives.erase(
             std::find(p->actives.begin(), p->actives.end(), act));
@@ -572,6 +597,10 @@ void* mxtpu_pipeline_create(const char* rec_path, const uint64_t* offsets,
   p->seed = seed;
   p->nthreads = std::max(1, nthreads);
   p->depth = std::max(2, depth);
+  // stripe: per-claim index shard.  Big enough to amortize the lock
+  // round-trip, small enough that every worker gets a share of each
+  // batch (>= 2 claims per worker per batch keeps the tail balanced).
+  p->stripe = std::max(1, std::min(8, batch / (2 * p->nthreads)));
   p->n_batches = int((n + batch - 1) / batch);
   p->slots.resize(p->depth);
   for (auto& s : p->slots) {
@@ -614,6 +643,55 @@ int mxtpu_pipeline_next_u8(void* h, uint8_t* data, float* labels,
     std::memcpy(data, b->data_u8.data(), b->data_u8.size());
     std::memcpy(labels, b->labels.data(), b->labels.size() * sizeof(float));
   }, errors);
+}
+
+// Zero-copy delivery: lend the next in-order batch's slot buffers to the
+// caller instead of memcpying them out.  `*token` identifies the loan;
+// `*data` points at the slot's NCHW planes (uint8 when the pipeline was
+// created with u8_out=1, float32 otherwise) and `*labels` at its label
+// rows.  The views stay valid until mxtpu_pipeline_release(token) (or
+// destroy); up to `prefetch_buffer` loans may be outstanding at once —
+// each outstanding loan shrinks the ring the decode workers can fill, so
+// consumers that hold K batches in flight (a depth-K device feed) should
+// create the pipeline with prefetch_buffer > K.  Returns >=0 pad count,
+// -1 epoch exhausted, -2 shutdown, -3 every slot already lent out
+// (waiting would deadlock: no worker can ever complete a batch).
+int mxtpu_pipeline_borrow(void* h, void** token, const void** data,
+                          const float** labels, int* errors) {
+  auto* p = static_cast<Pipeline*>(h);
+  Batch* b = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->next_deliver >= p->n_batches) return -1;
+    if (p->borrowed >= p->depth) return -3;
+    int want = p->next_deliver;
+    p->cv_done.wait(lk, [&] {
+      return p->stopping || p->completed.count(want);
+    });
+    if (p->stopping) return -2;
+    b = p->completed[want];
+    p->completed.erase(want);
+    p->next_deliver++;
+    p->borrowed++;
+  }
+  *token = b;
+  *data = p->aug.u8_out ? static_cast<const void*>(b->data_u8.data())
+                        : static_cast<const void*>(b->data.data());
+  *labels = b->labels.data();
+  if (errors) *errors = b->errors;
+  return b->pad;
+}
+
+// Return a borrowed slot to the free ring (its views become invalid).
+void mxtpu_pipeline_release(void* h, void* token) {
+  auto* p = static_cast<Pipeline*>(h);
+  auto* b = static_cast<Batch*>(token);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->borrowed--;
+    p->free_slots.push_back(b);
+  }
+  p->cv_work.notify_all();
 }
 
 void mxtpu_pipeline_reset(void* h) {

@@ -138,7 +138,15 @@ def gather_positions(data, positions):
     builds from ``gather_nd`` for masked-LM decoding (the reference
     ecosystem decodes ONLY the ~15% masked positions, so the vocab
     projection + softmax run on B*P rows, not B*S).  One XLA gather —
-    batched take_along_axis on the sequence axis."""
+    batched take_along_axis on the sequence axis.
+
+    Out-of-range positions are silently CLAMPED to ``[0, S-1]`` (the
+    TPU-friendly clip-gather convention every indexed op in this
+    framework uses; XLA has no trap-on-OOB gather).  This diverges from
+    reference ``gather_nd``, which would surface a bad position tensor
+    as an error — here a position of ``S`` reads row ``S-1`` and a
+    negative position reads row 0, so validate positions on the host if
+    corruption is a concern."""
     idx = jnp.clip(positions.astype(jnp.int32), 0, data.shape[1] - 1)
     return jnp.take_along_axis(data, idx[:, :, None], axis=1)
 

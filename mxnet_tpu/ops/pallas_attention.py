@@ -40,6 +40,14 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
+def _compiler_params(pltpu, **kw):
+    """``pltpu.CompilerParams`` with a fallback to the pre-rename
+    ``TPUCompilerParams`` (jax < 0.4.34) — same fields, same semantics."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -274,7 +282,7 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, Dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, *extra)
@@ -371,7 +379,7 @@ def pallas_flash_attention_bshd(q, k, v, causal=False, scale=None,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, Dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -454,20 +462,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _compute(use_mask):
-        q = q_ref[...].reshape(block_q, q_ref.shape[-1])
-        k = k_ref[...].reshape(block_k, k_ref.shape[-1])
-        v = v_ref[...].reshape(block_k, v_ref.shape[-1])
-        do = do_ref[...].reshape(block_q, do_ref.shape[-1])
-        lse_row = lse_ref[...].reshape(1, block_q)
-        dlt_row = dlt_ref[...].reshape(1, block_q)
-        pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
-                       seq_k, causal, kvlen=kvlen,
-                       qseg_row=qseg_ref[0] if has_seg else None,
-                       kseg_col=kseg_ref[0] if has_seg else None,
-                       use_mask=use_mask)
-        dpT = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-        dsT = pT * (dpT - dlt_row) * scale      # (block_k, block_q)
+        q, k, v, do, pT, dsT = _bwd_core(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qseg_ref,
+            kseg_ref, has_seg, use_mask, qi, ki, scale, causal,
+            block_q, block_k, seq_k, kvlen)
         acc_ref[...] += lax.dot_general(
             dsT.astype(q.dtype), k, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -509,23 +507,13 @@ def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _compute(use_mask):
-        q = q_ref[...].reshape(block_q, q_ref.shape[-1])
-        k = k_ref[...].reshape(block_k, k_ref.shape[-1])
-        v = v_ref[...].reshape(block_k, v_ref.shape[-1])
-        do = do_ref[...].reshape(block_q, do_ref.shape[-1])
-        lse_row = lse_ref[...].reshape(1, block_q)
-        dlt_row = dlt_ref[...].reshape(1, block_q)
-        pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
-                       seq_k, causal, kvlen=kvlen,
-                       qseg_row=qseg_ref[0] if has_seg else None,
-                       kseg_col=kseg_ref[0] if has_seg else None,
-                       use_mask=use_mask)
+        q, k, v, do, pT, dsT = _bwd_core(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qseg_ref,
+            kseg_ref, has_seg, use_mask, qi, ki, scale, causal,
+            block_q, block_k, seq_k, kvlen)
         dv_acc[...] += lax.dot_general(
             pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dpT = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-        dsT = pT * (dpT - dlt_row) * scale
         dq_ref[...] = lax.dot_general(
             dsT.astype(q.dtype), k, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(
@@ -568,23 +556,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _compute(use_mask):
-        q = q_ref[...].reshape(block_q, q_ref.shape[-1])
-        k = k_ref[...].reshape(block_k, k_ref.shape[-1])
-        v = v_ref[...].reshape(block_k, v_ref.shape[-1])
-        do = do_ref[...].reshape(block_q, do_ref.shape[-1])
-        lse_row = lse_ref[...].reshape(1, block_q)
-        dlt_row = dlt_ref[...].reshape(1, block_q)
-        pT = _scores_T(q, k, lse_row, scale, qi, ki, block_q, block_k,
-                       seq_k, causal, kvlen=kvlen,
-                       qseg_row=qseg_ref[0] if has_seg else None,
-                       kseg_col=kseg_ref[0] if has_seg else None,
-                       use_mask=use_mask)
+        q, k, v, do, pT, dsT = _bwd_core(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qseg_ref,
+            kseg_ref, has_seg, use_mask, qi, ki, scale, causal,
+            block_q, block_k, seq_k, kvlen)
         dv_acc[...] += lax.dot_general(
             pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dpT = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-        dsT = pT * (dpT - dlt_row) * scale
         dk_acc[...] += lax.dot_general(
             dsT.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -621,11 +599,16 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
     if Tk <= block_k:
-        # fused dqkv path (see below): its two live (block_k, block_q)
-        # fp32 score temporaries dominate VMEM — clamp block_q (to a
-        # power of two, keeping the padding tidy) so they stay inside
-        # the ~16 MB scoped budget with headroom
-        max_bq = max(8, (10 * 1024 * 1024) // (2 * 4 * block_k))
+        # fused dqkv path (see below): THREE (block_k, block_q) fp32
+        # score temporaries can be live at once — pT feeds dv before
+        # dpT/dsT are consumed — and they dominate VMEM, so clamp
+        # block_q (to a power of two, keeping the padding tidy) to hold
+        # them inside a 10 MiB slice of the ~16 MiB budget (the rest is
+        # the dk/dv fp32 accumulators and the q/k/v/do blocks).
+        # Arithmetic at defaults: block_k=2048 -> max_bq =
+        # 10 MiB / (3 * 4 B * 2048) = 426 -> block_q 256, i.e.
+        # 3 * 256 * 2048 * 4 B = 6 MiB of score temporaries.
+        max_bq = max(8, (10 * 1024 * 1024) // (3 * 4 * block_k))
         pow2 = 1 << (max_bq.bit_length() - 1)
         block_q = min(block_q, pow2)
 
@@ -696,7 +679,7 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
             ],
             scratch_shapes=[pltpu.VMEM((block_k, Dp), jnp.float32),
                             pltpu.VMEM((block_k, Dp), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(pltpu,
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interpret,
         )(qp, kp, vp, dop, lsep, dltp, *fused_extra)
@@ -740,7 +723,7 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
                                lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tqp, Dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, Dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, dltp, *dq_extra)
@@ -768,7 +751,7 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, Dp), jnp.float32),
                         pltpu.VMEM((block_k, Dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, dltp, *kv_extra)
@@ -846,7 +829,7 @@ def pallas_flash_attention_bwd_bshd(q, k, v, out, lse, do, causal=False,
                                lambda b, h, qi, ki: (b, qi, h)),
         out_shape=jax.ShapeDtypeStruct((B, Tqp, H * Dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, Dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -879,7 +862,7 @@ def pallas_flash_attention_bwd_bshd(q, k, v, out, lse, do, causal=False,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, Dp), jnp.float32),
                         pltpu.VMEM((block_k, Dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

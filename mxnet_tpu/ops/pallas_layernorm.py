@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .pallas_attention import _compiler_params
+
 __all__ = ["fused_layer_norm", "pallas_layer_norm_fwd",
            "pallas_layer_norm_bwd"]
 
@@ -158,7 +160,7 @@ def pallas_layer_norm_bwd(x2d, gamma, mu, rstd, ct2d,
         ],
         scratch_shapes=[pltpu.VMEM((1, C), jnp.float32),
                         pltpu.VMEM((1, C), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(xp, gamma.reshape(1, C), mup, rsp, ctp)

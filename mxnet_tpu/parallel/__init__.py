@@ -114,7 +114,10 @@ def shard_batch(x, mesh: Optional[Mesh] = None, axis: str = "dp"):
         return x
     val = x._data if isinstance(x, NDArray) else x
     spec = P(axis, *([None] * (val.ndim - 1)))
-    out = jax.device_put(val, NamedSharding(mesh, spec))
+    target = NamedSharding(mesh, spec)
+    if getattr(val, "sharding", None) == target:
+        return x  # pre-placed (e.g. DevicePrefetchIter(mesh=...)): no-op
+    out = jax.device_put(val, target)
     return _wrap(out, x.context) if isinstance(x, NDArray) else out
 
 

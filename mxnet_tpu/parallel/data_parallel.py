@@ -185,23 +185,28 @@ class DataParallelStep:
         """Shared prologue/epilogue for the per-call and scan paths:
         batch placement, compile-cache lookup, lr/step/RNG refresh, and
         the parameter/opt-state writeback."""
-        from . import shard_batch
-
         def prep(x):
             if x is None:
                 return None
             val = x._data if isinstance(x, NDArray) else jnp.asarray(x)
             if self._mesh is not None:
+                import jax.sharding as jsh
                 if scan:
                     # leading dim is the step axis; the batch (dim 1) is
                     # the one sharded over dp
-                    import jax.sharding as jsh
                     spec = jsh.PartitionSpec(None, "dp",
                                              *([None] * (val.ndim - 2)))
-                    val = jax.device_put(
-                        val, jsh.NamedSharding(self._mesh, spec))
                 else:
-                    val = shard_batch(val, self._mesh)
+                    spec = jsh.PartitionSpec("dp",
+                                             *([None] * (val.ndim - 1)))
+                target = jsh.NamedSharding(self._mesh, spec)
+                # batches pre-placed by the input pipeline
+                # (``DevicePrefetchIter(mesh=...)`` lays per-replica
+                # shards directly on their target devices) skip even the
+                # no-op device_put dispatch
+                if getattr(val, "sharding", None) == target:
+                    return val
+                val = jax.device_put(val, target)
             return val
 
         # data may be a tuple of forward inputs (None entries allowed),
@@ -270,7 +275,12 @@ class DataParallelStep:
         net, loss_fn, optimizer = self._net, self._loss, self._opt
         params = self._params
         trainable = self._trainable
-        treedefs = self._state_treedefs
+        # NOTE: self._state_treedefs describes the optimizer-created
+        # state pytree ONLY — multi-precision slots carry the fp32
+        # master as an EXTRA leaf 0 prepended after flattening, which
+        # the stored treedef does not cover.  Any state (de)serializer
+        # must strip/re-prepend that leaf for slots where
+        # self._mp_slots[slot] is True before unflattening.
         mp_slots = self._mp_slots
         n = len(params)
         trainset = set(trainable)

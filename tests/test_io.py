@@ -297,3 +297,143 @@ def test_device_prefetch_iter_u8_normalize_and_order():
     assert len(again) == 5
     onp.testing.assert_allclose(again[2].data[0].asnumpy(),
                                 got[2].data[0].asnumpy())
+
+
+def _mk_u8_base(batches, labels, mean, std):
+    """Tiny synthetic u8-wire DataIter for DevicePrefetchIter tests."""
+    from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+    import mxnet_tpu as mx
+
+    class U8Iter(DataIter):
+        def __init__(self):
+            super().__init__(batches[0].shape[0])
+            self.i = 0
+            self.mean = mean
+            self.std = std
+
+        @property
+        def provide_data(self):
+            return [DataDesc("data", batches[0].shape)]
+
+        @property
+        def provide_label(self):
+            return [DataDesc("softmax_label", labels[0].shape)]
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= len(batches):
+                raise StopIteration
+            b = DataBatch([mx.nd.array(batches[self.i], dtype="uint8")],
+                          [mx.nd.array(labels[self.i])],
+                          pad=2 if self.i == len(batches) - 1 else 0)
+            self.i += 1
+            return b
+
+    return U8Iter()
+
+
+def test_device_prefetch_depth_k_order_and_reset():
+    """depth >= 2 keeps several transfers in flight; delivery must stay
+    in order with pads intact, reset mid-stream must restart cleanly,
+    and an extra reset after exhaustion must replay the epoch."""
+    import numpy as onp
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    rs = onp.random.RandomState(1)
+    batches = [rs.randint(0, 255, (4, 3, 8, 8), dtype=onp.uint8)
+               for _ in range(7)]
+    labels = [onp.arange(4, dtype="float32") + 10 * i for i in range(7)]
+    mean = onp.array([100.0, 110.0, 120.0], "float32")
+    std = onp.array([50.0, 55.0, 60.0], "float32")
+
+    for depth in (2, 4):
+        feed = DevicePrefetchIter(_mk_u8_base(batches, labels, mean, std),
+                                  dtype="float32", depth=depth)
+        first = feed.next()
+        want0 = (batches[0].astype("float32")
+                 - mean.reshape(1, 3, 1, 1)) / std.reshape(1, 3, 1, 1)
+        onp.testing.assert_allclose(first.data[0].asnumpy(), want0,
+                                    rtol=1e-6)
+        feed.reset()                      # mid-stream (queue was primed)
+        got = list(feed)
+        assert len(got) == 7
+        for i, b in enumerate(got):
+            want = (batches[i].astype("float32")
+                    - mean.reshape(1, 3, 1, 1)) / std.reshape(1, 3, 1, 1)
+            onp.testing.assert_allclose(b.data[0].asnumpy(), want,
+                                        rtol=1e-6)
+            onp.testing.assert_allclose(b.label[0].asnumpy(), labels[i])
+            assert b.pad == (2 if i == 6 else 0)
+        feed.reset()                      # after exhaustion
+        assert len(list(feed)) == 7
+        feed.close()
+
+
+def test_device_prefetch_clean_shutdown_and_gc():
+    """close() must join the feeder thread, and a DROPPED iterator (GC,
+    no close) must not leak its feeder: the weakref-based loop exits
+    once the finalizer fires."""
+    import gc
+    import time
+    import threading
+    import numpy as onp
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    def feeders():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("DevicePrefetchIter")]
+
+    rs = onp.random.RandomState(2)
+    batches = [rs.randint(0, 255, (2, 3, 4, 4), dtype=onp.uint8)
+               for _ in range(6)]
+    labels = [onp.zeros(2, "float32") for _ in range(6)]
+    base = feeders()
+
+    feed = DevicePrefetchIter(_mk_u8_base(batches, labels, None, None),
+                              dtype="float32", depth=1)
+    feed.next()
+    feed.close()
+    assert feeders() == base
+
+    feed2 = DevicePrefetchIter(_mk_u8_base(batches, labels, None, None),
+                               dtype="float32", depth=1)
+    feed2.next()                          # feeder alive, queue primed
+    del feed2
+    gc.collect()
+    deadline = time.time() + 5.0
+    while feeders() != base and time.time() < deadline:
+        time.sleep(0.05)
+    assert feeders() == base
+
+
+def test_device_prefetch_error_passthrough():
+    """An exception in the base iterator must surface on next(), not
+    vanish in the feeder thread."""
+    import pytest
+    from mxnet_tpu.io import DataDesc, DataIter
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    class Boom(DataIter):
+        def __init__(self):
+            super().__init__(2)
+
+        @property
+        def provide_data(self):
+            return [DataDesc("data", (2, 3, 4, 4))]
+
+        @property
+        def provide_label(self):
+            return [DataDesc("softmax_label", (2,))]
+
+        def reset(self):
+            pass
+
+        def next(self):
+            raise RuntimeError("decode exploded")
+
+    feed = DevicePrefetchIter(Boom(), dtype="float32")
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        feed.next()
+    feed.close()

@@ -198,6 +198,68 @@ def test_pipeline_thread_count_invariant(rec_file):
             assert p0 == p1
 
 
+def test_pipeline_borrow_release_parity(rec_file):
+    """The zero-copy borrow path must deliver the same batches, in the
+    same order, as the copying path — including with several loans
+    outstanding at once (the depth-K device feed's usage pattern)."""
+    f = native.NativeRecordFile(rec_file["jrec"])
+    offs = f.scan()
+    f.close()
+    kw = dict(batch_size=4, data_shape=(3, 16, 16), shuffle=True, seed=21,
+              preprocess_threads=3, rand_crop=True, rand_mirror=True)
+    pc = native.NativeImagePipeline(rec_file["jrec"], offs, **kw)
+    pb = native.NativeImagePipeline(rec_file["jrec"], offs,
+                                    prefetch_buffer=4, **kw)
+    pending = []
+    for _ in range(pc.num_batches):
+        dc, lc, padc, ec = pc.next()
+        out = pb.next_borrow()
+        assert out is not None
+        db, lb, padb, eb, token = out
+        # the view aliases the slot; compare before release
+        assert onp.array_equal(dc, db)
+        assert onp.array_equal(lc, lb) and padc == padb and ec == eb
+        pending.append(token)
+        if len(pending) >= 3:       # hold 3 loans in flight
+            pb.release(pending.pop(0))
+    for t in pending:
+        pb.release(t)
+    assert pb.next_borrow() is None
+    # a released ring still supports reset + a clean full epoch
+    pb.reset()
+    tot = 0
+    for _ in range(pb.num_batches):
+        out = pb.next_borrow()
+        tot += 4 - out[2]
+        pb.release(out[4])
+    assert tot == 23
+    pc.close()
+    pb.close()
+
+
+def test_image_record_iter_borrow_matches_host(rec_file):
+    """ImageRecordIter.next_borrow: same stream as next_host, slot
+    released via the returned callable."""
+    kw = dict(path_imgrec=rec_file["jrec"], data_shape=(3, 16, 16),
+              batch_size=4, preprocess_threads=2, u8_output=True,
+              shuffle=True, seed=2)
+    a = mx.io.ImageRecordIter(**kw)
+    b = mx.io.ImageRecordIter(**kw)
+    while True:
+        try:
+            dh, lh, padh = a.next_host()
+        except StopIteration:
+            with pytest.raises(StopIteration):
+                b.next_borrow()
+            break
+        db, lb, padb, release = b.next_borrow()
+        assert onp.array_equal(dh, db) and onp.array_equal(lh, lb)
+        assert padh == padb
+        release()
+    a.close()
+    b.close()
+
+
 def test_pipeline_u8_output_parity(rec_file):
     """u8 mode returns the raw crop planes; normalizing them on the host
     must reproduce the f32 mode exactly (same RNG keying)."""
@@ -223,3 +285,33 @@ def test_pipeline_u8_output_parity(rec_file):
         assert onp.array_equal(lf, lu) and padf == padu and ef == eu
     pf.close()
     pu.close()
+
+
+def test_device_prefetch_over_native_u8_matches_f32_pipeline(rec_file):
+    """Full path: native u8 decode -> borrowed slot -> DevicePrefetchIter
+    (depth-K feeder, device_put, pre-jitted on-device normalize) must
+    reproduce the host-normalized float32 pipeline bit-for-bit batch
+    stream (order included), across a reset."""
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    kw = dict(path_imgrec=rec_file["jrec"], data_shape=(3, 16, 16),
+              batch_size=4, shuffle=True, seed=7, preprocess_threads=3,
+              rand_crop=True, rand_mirror=True,
+              mean_r=123.68, mean_g=116.78, mean_b=103.94,
+              std_r=58.4, std_g=57.12, std_b=57.38)
+    ref = mx.io.ImageRecordIter(u8_output=False, **kw)
+    feed = DevicePrefetchIter(mx.io.ImageRecordIter(u8_output=True, **kw),
+                              dtype="float32", depth=3)
+    for _ in range(2):                       # second pass exercises reset
+        n_batches = 0
+        for rb, db in zip(ref, feed):
+            onp.testing.assert_allclose(db.data[0].asnumpy(),
+                                        rb.data[0].asnumpy(), atol=1e-4)
+            onp.testing.assert_allclose(db.label[0].asnumpy(),
+                                        rb.label[0].asnumpy())
+            assert db.pad == rb.pad
+            n_batches += 1
+        assert n_batches == 6
+        ref.reset()
+        feed.reset()
+    feed.close()
