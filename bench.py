@@ -686,12 +686,17 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from mxnet_tpu.ops.pallas_attention import flash_attention
+    from mxnet_tpu.ops.pallas_attention import (flash_attention,
+                                                attention_dispatch)
 
     rs = onp.random.RandomState(0)
     shape = (batch, heads, seqlen, head_dim)
     q, k, v = (jnp.asarray(rs.uniform(-1, 1, shape).astype("float32"),
                            dtype) for _ in range(3))
+    # which kernel the dispatcher picks for this shape (short_seq |
+    # streaming | dense_fallback) — recorded so BENCH rounds can see the
+    # dispatch decision next to the measured speedup
+    plan = attention_dispatch(seqlen, seqlen, head_dim, dtype)
 
     def dense(q, k, v):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (head_dim ** 0.5)
@@ -713,15 +718,17 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
         return loop
 
     # true executed FLOPs per path.  flash: fwd 2 dots; backward 5 when
-    # the whole K axis fits one block (the fused dqkv kernel shares the
-    # score/dp recompute — S <= 2048 with default blocks) else 7 (split
-    # dq + dkv kernels each recompute).  dense runs 6 (fwd 2; bwd dp,
-    # dv, dq, dk — softmax residuals saved).
+    # the whole K axis fits one block (the fused/single dqkv kernel
+    # shares the score/dp recompute — S <= 2048 with tuned blocks) else
+    # 7 (split dq + dkv kernels each recompute).  dense runs 6 (fwd 2;
+    # bwd dp, dv, dq, dk — softmax residuals saved).
     dot = 2 * batch * heads * seqlen * seqlen * head_dim
-    fused_bwd = seqlen <= 2048
+    fused_bwd = seqlen <= (plan["block_k"] or 2048)
     n_dots = {"flash": 7 if fused_bwd else 9, "dense": 6}
     out = {"bench": "attention", "shape": list(shape), "dtype": dtype,
            "inner_iters": inner, "grads": "q,k,v",
+           "kernel": plan["kernel"],
+           "block_q": plan["block_q"], "block_k": plan["block_k"],
            "bwd_kernel": "fused_dqkv" if fused_bwd else "split"}
     for name, fn in (("flash", flash_attention), ("dense", dense)):
         try:
@@ -955,6 +962,41 @@ def main():
     if flags:
         out["sanity_flags"] = flags
     print(json.dumps(out))
+    hard = _hard_failures(details)
+    if hard:
+        # numerics gate: the artifact still ships (printed above), but a
+        # wrong kernel or a dispatch choice that loses to dense fails the
+        # run — perf runs double as correctness gates
+        for h in hard:
+            print("# HARD FAIL: %s" % h, file=sys.stderr)
+        sys.exit(3)
+
+
+def _hard_failures(details):
+    """Failures that exit the bench nonzero (unlike _sanity_gates flags):
+
+      * any ``max_err_ok: false`` — a kernel produced wrong numbers on
+        chip, so every throughput number in the artifact is suspect;
+      * ``flash_speedup < 1.0`` at S=512 when a kernel (not the dense
+        fallback) was dispatched — the round-5 regression shape; the
+        dispatcher exists precisely so this shape never loses to dense.
+    """
+    hard = []
+    for d in details:
+        if not isinstance(d, dict):
+            continue
+        if d.get("max_err_ok") is False:
+            hard.append("max_err_ok false: %s %s max_err=%s"
+                        % (d.get("bench"), d.get("shape"),
+                           d.get("max_err")))
+        if d.get("bench") == "attention" \
+                and (d.get("shape") or [None] * 3)[2] == 512 \
+                and d.get("kernel") not in (None, "dense_fallback") \
+                and d.get("flash_speedup") is not None \
+                and d["flash_speedup"] < 1.0:
+            hard.append("attention S=512 flash_speedup %.2f < 1.0 "
+                        "(kernel=%s)" % (d["flash_speedup"], d["kernel"]))
+    return hard
 
 
 def _train_key(d):
@@ -988,6 +1030,17 @@ def _sanity_gates(details):
                          "tolerance vs the dense oracle"
                          % (d.get("bench"), d.get("shape"),
                             d.get("max_err")))
+        if isinstance(d, dict) and d.get("bench") == "attention" \
+                and d.get("kernel") not in (None, "dense_fallback") \
+                and d.get("flash_speedup") is not None \
+                and d["flash_speedup"] < 1.0:
+            # on-chip dispatch contract: flash (with the dispatcher's
+            # kernel choice) must never lose to dense at a benched shape
+            flags.append("KERNEL REGRESSION: attention %s kernel=%s "
+                         "flash_speedup %.2f < 1.0 — dispatcher picked a "
+                         "kernel that loses to dense XLA"
+                         % (d.get("shape"), d.get("kernel"),
+                            d["flash_speedup"]))
     hist = _load_history()
     if hist:
         prev = {}

@@ -14,8 +14,8 @@ from ...ndarray import NDArray
 from ..block import Block, HybridBlock
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-           "BatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "Flatten",
-           "Lambda", "HybridLambda", "Activation"]
+           "BatchNorm", "BatchNormAddReLU", "InstanceNorm", "LayerNorm",
+           "GroupNorm", "Flatten", "Lambda", "HybridLambda", "Activation"]
 
 
 class Sequential(Block):
@@ -223,6 +223,32 @@ class BatchNorm(HybridBlock):
         in_channels = self.gamma.shape[0] if self.gamma.shape else None
         return "BatchNorm(axis=%d, eps=%s, momentum=%s, in_channels=%s)" % (
             self._axis, self._kwargs["eps"], self._momentum, in_channels)
+
+
+class BatchNormAddReLU(BatchNorm):
+    """BatchNorm whose output is fused with a residual add + ReLU:
+    ``relu(BN(x) + residual)`` — the tail of every ResNet v1 residual
+    unit (reference: cuDNN's BatchNormAddRelu fusion).  Same parameters,
+    same moving-stats handling, and the same auto-naming alias as
+    :class:`BatchNorm`, so substituting it for the last BatchNorm of a
+    residual body keeps parameter names and checkpoints identical.  The
+    elementwise tail runs in the fused Pallas epilogue kernel on TPU
+    (``ops/pallas_fused_norm.py``)."""
+
+    def _alias(self):
+        return "batchnorm"
+
+    def hybrid_forward(self, F, x, residual, gamma, beta, running_mean,
+                       running_var):
+        out, mean, var = F.BatchNormAddRelu(
+            x, residual, gamma, beta, running_mean, running_var,
+            name="fwd", **self._kwargs)
+        if autograd.is_training() and not self._kwargs["use_global_stats"]:
+            m = self._momentum
+            with autograd.pause():
+                self.running_mean.set_data(running_mean * m + mean * (1 - m))
+                self.running_var.set_data(running_var * m + var * (1 - m))
+        return out
 
 
 class InstanceNorm(HybridBlock):

@@ -50,8 +50,9 @@ class _FusedUpdate:
     row-sparse — the caller then runs the eager per-parameter loop.
     """
 
-    def __init__(self, updater):
+    def __init__(self, updater, donate_grads=False):
         self._updater = updater
+        self._donate_grads = donate_grads
         self._cache = {}
         self._unavailable = False
 
@@ -136,8 +137,14 @@ class _FusedUpdate:
                 return new_w, new_s
 
             # donate weights + states: the update is in-place at the XLA
-            # level, matching the reference's kWriteInplace update ops
-            jfn = jax.jit(fused, donate_argnums=(0, 2))
+            # level, matching the reference's kWriteInplace update ops.
+            # Gradients join the donation only on request (Trainer
+            # donate_grads=True): the step is their last reader — the
+            # next backward rebinds fresh buffers — but a caller reading
+            # param.grad() between step() and backward() would see a
+            # freed buffer, so the default keeps them live.
+            donate = (0, 1, 2) if self._donate_grads else (0, 2)
+            jfn = jax.jit(fused, donate_argnums=donate)
             self._cache[key] = jfn
         # count the step only once the fused path is committed to running —
         # the eager fallback does its own counting
@@ -171,10 +178,16 @@ class Trainer:
     update_on_kvstore : bool, default None — kept for API parity; updates
         always run through the store's updater (the reference's
         update_on_kvstore=True semantics, which its dist path requires too).
+    donate_grads : bool, default False — also donate the gradient buffers
+        into the fused update program (pure-copy elimination).  Opt-in:
+        after ``step()`` the old gradient buffers are consumed, so the
+        caller must not read ``param.grad()`` until the next
+        ``backward()`` rebinds them.
     """
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 donate_grads=False):
         param_list = []
         if isinstance(params, (dict, ParameterDict)):
             for key in sorted(list(params.keys())):
@@ -204,6 +217,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init = []
+        self._donate_grads = donate_grads
         self._kv_fused = None
         self._local_fused = None
         self._reset_kvstore()
@@ -339,7 +353,8 @@ class Trainer:
             if jax.process_count() > 1:
                 return False
         if self._kv_fused is None or self._kv_fused._updater is not store._updater:
-            self._kv_fused = _FusedUpdate(store._updater)
+            self._kv_fused = _FusedUpdate(store._updater,
+                                          donate_grads=self._donate_grads)
         indices, grads, weights = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -386,7 +401,8 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._local_fused is None or \
                 self._local_fused._updater is not self._updaters:
-            self._local_fused = _FusedUpdate(self._updaters)
+            self._local_fused = _FusedUpdate(self._updaters,
+                                             donate_grads=self._donate_grads)
         indices, grads, weights = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":

@@ -186,7 +186,14 @@ class DevicePrefetchIter(DataIter):
             # by the time the ring wraps (depth+2 batches later) this
             # transfer is long done — the block is a cheap no-op guard
             for a in guard:
-                a.block_until_ready()
+                try:
+                    a.block_until_ready()
+                except RuntimeError:
+                    # a donating consumer (DataParallelStep with
+                    # donate_batch=True) already consumed-and-freed the
+                    # array — the transfer it derived from is necessarily
+                    # complete, so the slot is safe to rewrite
+                    pass
             self._ring_guard[i] = None
         buf = self._ring[i]
         if buf is None or buf.shape != view.shape or buf.dtype != view.dtype:

@@ -129,6 +129,15 @@ class NDArray:
         """The underlying jax.Array (TPU-native escape hatch)."""
         return self._data
 
+    def mark_borrowed(self) -> "NDArray":
+        """Flag this array's buffer as lent out — still referenced by its
+        producer (e.g. an input-pipeline staging ring) after the consumer
+        is done with it.  Buffer-donating consumers
+        (``DataParallelStep(donate_batch=True)``) honour the flag by
+        donating a private copy instead of this buffer."""
+        self._borrowed = True
+        return self
+
     def astype(self, dtype, copy: bool = True) -> "NDArray":
         if not copy and onp.dtype(dtype) == self.dtype:
             return self

@@ -251,6 +251,46 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var,
     return _bn_apply(data, mean, var, gamma, beta, eps, fix_gamma, axis)
 
 
+@register("_contrib_BatchNormAddRelu", num_outputs=3, needs_training=True,
+          aliases=("BatchNormAddRelu",))
+def batch_norm_add_relu(data, residual, gamma, beta, moving_mean, moving_var,
+                        eps: float = 1e-3, momentum: float = 0.9,
+                        fix_gamma: bool = True,
+                        use_global_stats: bool = False,
+                        output_mean_var: bool = False, axis: int = 1,
+                        cudnn_off: bool = False, training: bool = True):
+    """BatchNorm → residual add → ReLU as ONE epilogue (reference: the
+    cuDNN ``BatchNormAddRelu`` fused op MXNet enables on GPU for exactly
+    the ResNet residual-unit tail).
+
+    Statistics are computed exactly as :func:`batch_norm` (one-pass
+    E[x²]−E[x]² in fp32, clamped, remat-named); the normalize/affine is
+    folded into per-channel fp32 scale/shift and the elementwise tail
+    ``relu(x*scale + shift + residual)`` runs in the fused Pallas
+    epilogue kernel on TPU (``ops/pallas_fused_norm.py``) — one read +
+    one write instead of the 2-3 loop fusions XLA emits for the
+    composed form (profiled at ~13% of the ResNet-50 step).  Returns
+    (out, batch_mean, batch_var) like BatchNorm; the moving-average
+    update stays with the caller."""
+    from .pallas_fused_norm import fused_bn_add_relu_epilogue
+
+    ax = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
+    if use_global_stats or not training:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=ax, dtype=jnp.float32)
+        sq = jnp.mean(jnp.square(data), axis=ax, dtype=jnp.float32)
+        var = jnp.maximum(sq - jnp.square(mean), 0.0)
+        mean = _remat_name(mean.astype(data.dtype), "bn_stats")
+        var = _remat_name(var.astype(data.dtype), "bn_stats")
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps) * g.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * inv
+    out = fused_bn_add_relu_epilogue(data, inv, shift, residual,
+                                     axis % data.ndim)
+    return out, lax.stop_gradient(mean), lax.stop_gradient(var)
+
+
 def _bound_axis_names():
     """Mapped-context axis names currently in scope (None if the
     introspection API is unavailable in this jax version)."""

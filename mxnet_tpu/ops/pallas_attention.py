@@ -34,10 +34,84 @@ from .registry import register
 
 __all__ = ["flash_attention", "flash_attention_bshd",
            "pallas_flash_attention", "pallas_flash_attention_bshd",
-           "pallas_flash_attention_bwd", "pallas_flash_attention_bwd_bshd"]
+           "pallas_flash_attention_bwd", "pallas_flash_attention_bwd_bshd",
+           "attention_dispatch", "tune_attention_blocks"]
 
 _NEG_INF = -1e30
 _LANES = 128
+
+# Kernel-selection constants (see attention_dispatch):
+#  * _SHORT_SEQ_MAX_TK: the largest K extent the single-pass kernel takes
+#    whole as ONE block — above it the streaming online-softmax kernel
+#    amortizes better than a giant score tile;
+#  * _DENSE_MIN_SEQ: below this, one XLA dot covers the whole score
+#    matrix and the pallas grid/DMA setup costs more than it saves —
+#    dense must win, so the dispatcher never sends these to a kernel;
+#  * _VMEM_CLAMP: budget for a kernel invocation's VMEM working set
+#    (blocks + fp32 score tile + scratch), leaving headroom out of the
+#    ~16 MiB/core for Mosaic's double buffering.
+_SHORT_SEQ_MAX_TK = 1024
+_DENSE_MIN_SEQ = 128
+_VMEM_CLAMP = 12 * 1024 * 1024
+
+
+def _fwd_vmem_bytes(block_q, block_k, Dp, itemsize):
+    """Forward working set of one grid step: q/o blocks, k/v blocks, the
+    fp32 score tile (exp/normalize reuse its buffer — ONE live copy),
+    and the m/l/acc scratch rows."""
+    qo = 2 * block_q * Dp * itemsize
+    kv = 2 * block_k * Dp * itemsize
+    score = block_q * block_k * 4
+    scratch = block_q * (2 * _LANES + Dp) * 4
+    return qo + kv + score + scratch
+
+
+def tune_attention_blocks(seq_q, seq_k, head_dim, dtype="bfloat16"):
+    """Default (block_q, block_k) for a (S, D, dtype) attention shape.
+
+    Short K axes (<= _SHORT_SEQ_MAX_TK) take the whole axis as one
+    lane-aligned block so the single-pass kernel applies; long axes keep
+    the v5e-tuned streaming defaults (1024, 2048), halved until the
+    working set honours the VMEM clamp (large D / fp32 shapes)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    Dp = head_dim + (-head_dim) % 64
+    if seq_k <= _SHORT_SEQ_MAX_TK:
+        block_k = max(_LANES, seq_k + (-seq_k) % _LANES)
+        block_q = min(max(8, seq_q + (-seq_q) % 8), 512)
+        while block_q > 128 and \
+                _fwd_vmem_bytes(block_q, block_k, Dp, itemsize) > _VMEM_CLAMP:
+            block_q //= 2
+        return block_q, block_k
+    block_q, block_k = 1024, 2048
+    while block_k > 512 and \
+            _fwd_vmem_bytes(block_q, block_k, Dp, itemsize) > _VMEM_CLAMP:
+        block_k //= 2
+    while block_q > 256 and \
+            _fwd_vmem_bytes(block_q, block_k, Dp, itemsize) > _VMEM_CLAMP:
+        block_q //= 2
+    return block_q, block_k
+
+
+def attention_dispatch(seq_q, seq_k, head_dim, dtype="bfloat16",
+                       on_tpu=None):
+    """Per-shape kernel choice for the public flash-attention ops.
+
+    Returns ``{"kernel": "short_seq" | "streaming" | "dense_fallback",
+    "block_q": int | None, "block_k": int | None}``.  ``short_seq`` is
+    the single-pass kernel (whole K axis in one block — no online-softmax
+    streaming state), ``streaming`` the K-sequential online-softmax
+    kernel, ``dense_fallback`` composed XLA attention.  The heuristic is
+    chosen so no caller shape regresses below dense: tiny sequences
+    (min(Tq, Tk) < _DENSE_MIN_SEQ) go dense, Tk <= _SHORT_SEQ_MAX_TK
+    single-pass, longer streams.  Chosen blocks always satisfy the VMEM
+    clamp (tune_attention_blocks)."""
+    if on_tpu is None:
+        on_tpu = _use_pallas()
+    if not on_tpu or min(seq_q, seq_k) < _DENSE_MIN_SEQ:
+        return {"kernel": "dense_fallback", "block_q": None, "block_k": None}
+    block_q, block_k = tune_attention_blocks(seq_q, seq_k, head_dim, dtype)
+    kernel = "short_seq" if seq_k <= block_k else "streaming"
+    return {"kernel": kernel, "block_q": block_q, "block_k": block_k}
 
 
 def _compiler_params(pltpu, **kw):
@@ -53,25 +127,55 @@ def _compiler_params(pltpu, **kw):
 # ---------------------------------------------------------------------------
 
 def _run_mask_specialized(pl, compute, run, qi, ki, block_q, block_k,
-                          causal, has_lens, has_seg, needs_tail):
-    """Shared mask-dispatch ladder for all three kernels.
+                          causal, has_lens, has_seg, needs_tail,
+                          kvlen=None, seq_k=None):
+    """Shared mask-dispatch ladder for all the kernels.
 
     ``compute(use_mask)`` runs the block; this picks the cheapest correct
-    specialization: no mask at all when nothing can mask the block, a
-    full-block/diagonal-straddle split for causal-only (blocks wholly
-    below the diagonal are fully visible), else the masked path guarded
-    by ``run`` (block-skip predicate)."""
+    specialization.  A block needs NO mask when it sits wholly below the
+    causal diagonal, wholly inside the valid key length (``kvlen``, a
+    traced per-row scalar when ``kv_lens`` is present), and wholly inside
+    the true (unpadded) K extent ``seq_k`` — so deep-inside-valid-region
+    blocks skip the iota/compare/select chain even in masked configs
+    (previously any kv_lens/tail config sent EVERY block down the masked
+    slow path).  Segment ids can flip anywhere inside a block, so they
+    always take the masked path, guarded by ``run`` (block-skip
+    predicate)."""
     masked = has_lens or has_seg or causal or needs_tail
     if not masked:
         compute(False)
-    elif causal and not (has_lens or has_seg or needs_tail):
-        full = (qi * block_q) >= (ki * block_k + block_k - 1)
+        return
+    if has_seg:
+        if run is True:
+            compute(True)
+        else:
+            pl.when(run)(lambda: compute(True))
+        return
+    conds = []
+    if causal:
+        # block wholly below the diagonal: every row sees every column
+        conds.append((qi * block_q) >= (ki * block_k + block_k - 1))
+    if has_lens:
+        conds.append((ki * block_k + block_k) <= kvlen)
+    if needs_tail:
+        conds.append((ki * block_k + block_k) <= seq_k)
+    full = conds[0]
+    for c in conds[1:]:
+        full = jnp.logical_and(full, c)
+    if isinstance(full, (bool, int)):
+        # every predicate was static (python grid coords, e.g. the
+        # single-block backward) — no pl.when needed
+        if run is True:
+            compute(not full)
+        else:
+            pl.when(run)(lambda: compute(not full))
+        return
+    if run is True:
+        pl.when(full)(lambda: compute(False))
+        pl.when(jnp.logical_not(full))(lambda: compute(True))
+    else:
         pl.when(run & full)(lambda: compute(False))
         pl.when(run & jnp.logical_not(full))(lambda: compute(True))
-    elif run is True:
-        compute(True)
-    else:
-        pl.when(run)(lambda: compute(True))
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
@@ -153,7 +257,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
         # skip K blocks entirely past this batch row's valid length
         run = run & (ki * block_k < kvlen)
     _run_mask_specialized(pl, _compute, run, qi, ki, block_q, block_k,
-                          causal, has_lens, has_seg, needs_tail)
+                          causal, has_lens, has_seg, needs_tail,
+                          kvlen=kvlen, seq_k=seq_k)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -165,6 +270,68 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
         # backward recompute yields exp(-1e30 - 0) == 0, never NaN
         lse = jnp.where(l > 0, m + jnp.log(l), 0.0)      # (block_q, 1)
         lse_ref[...] = lse.reshape(lse_ref.shape)
+
+
+def _fwd_kernel_single(q_ref, k_ref, v_ref, *rest, scale, causal, block_q,
+                       block_k, seq_k, seq_k_padded, has_lens, has_seg,
+                       pid_off=0):
+    """Short-sequence forward: the whole K axis is ONE block, so the
+    online-softmax streaming machinery — m/l VMEM scratch carried across
+    K iterations, the per-iteration accumulator rescale, the init/
+    finalize grid-edge phases — collapses to a single-pass softmax over
+    one resident score tile.  Same mask ladder, same outputs (o, lse),
+    no scratch at all."""
+    import jax.experimental.pallas as pl
+
+    rest = list(rest)
+    lens_ref = rest.pop(0) if has_lens else None
+    qseg_ref = rest.pop(0) if has_seg else None
+    kseg_ref = rest.pop(0) if has_seg else None
+    o_ref, lse_ref = rest
+
+    bi = pl.program_id(0)
+    qi = pl.program_id(1 + pid_off)
+    ki = 0
+    kvlen = lens_ref[bi, 0] if has_lens else None
+    needs_tail = seq_k != seq_k_padded
+
+    def _compute(use_mask):
+        q = q_ref[...].reshape(block_q, q_ref.shape[-1])
+        k = k_ref[...].reshape(block_k, k_ref.shape[-1])
+        v = v_ref[...].reshape(block_k, v_ref.shape[-1])
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if use_mask:
+            col = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = col < (kvlen if has_lens else seq_k)
+            if causal:
+                row = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                mask = mask & (row >= col)
+            if has_seg:
+                mask = mask & (qseg_ref[0] == kseg_ref[0])
+            s = jnp.where(mask, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        # fully-masked rows: m == _NEG_INF makes exp(s - m) == 1 on the
+        # masked entries — zero them so the row stays empty (l == 0)
+        p = jnp.exp(s - m)
+        if use_mask:
+            p = jnp.where(mask, p, 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        o_ref[...] = (acc / jnp.where(l > 0, l, 1.0)).astype(
+            o_ref.dtype).reshape(o_ref.shape)
+        lse = jnp.where(l > 0, m + jnp.log(l), 0.0)
+        lse_ref[...] = lse.reshape(lse_ref.shape)
+
+    # run stays True: with a single K block every q block must execute
+    # (its o/lse outputs have no other writer); fully-masked rows emit
+    # exact zeros through the mask.  The ladder still specializes
+    # blocks nothing can mask down to the mask-free path.
+    _run_mask_specialized(pl, _compute, True, qi, ki, block_q, block_k,
+                          causal, has_lens, has_seg, needs_tail,
+                          kvlen=kvlen, seq_k=seq_k)
 
 
 def _pad_qkv(q, k, v, block_q, block_k):
@@ -214,13 +381,19 @@ def _expand_mask_operands(kv_lens, q_segments, kv_segments, B, H, Tqp, Tkp,
 
 
 def pallas_flash_attention(q, k, v, causal=False, scale=None,
-                           block_q: int = 1024, block_k: int = 2048,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None,
                            interpret: bool = False, return_lse: bool = False,
                            kv_lens=None, q_segments=None, kv_segments=None):
-    # Defaults tuned on a v5e chip (S=2048, D=64 fwd+bwd sweep): (1024, 2048)
-    # sustains ~61 TF/s vs ~35 TF/s for XLA dense attention; blocks are
-    # capped at the sequence length so short inputs degrade gracefully.
+    # Default blocks come from tune_attention_blocks: (1024, 2048) on the
+    # streaming path (v5e S=2048, D=64 fwd+bwd sweep: ~61 TF/s vs ~35 TF/s
+    # for XLA dense attention), the whole lane-aligned K axis as one block
+    # for S <= _SHORT_SEQ_MAX_TK, which routes to the single-pass kernel.
     """Raw kernel entry: q/k/v (B, H, T, D) → (B, H, Tq, D) [, lse].
+
+    When the padded K axis fits ONE block (n_k == 1) the single-pass
+    ``_fwd_kernel_single`` runs instead of the streaming online-softmax
+    kernel — no m/l scratch carry, no accumulator rescale.
 
     ``kv_lens`` (B,) int masks keys at/after the per-row valid length —
     K blocks wholly past it are skipped, the partial block is masked
@@ -236,6 +409,10 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
     if (q_segments is None) != (kv_segments is None):
         raise ValueError("q_segments and kv_segments go together")
 
+    if block_q is None or block_k is None:
+        tq, tk = tune_attention_blocks(Tq, Tk, D, q.dtype)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
     qp, kp, vp, Tqp, Tkp, Dp = _pad_qkv(q, k, v, block_q, block_k)
@@ -244,23 +421,56 @@ def pallas_flash_attention(q, k, v, causal=False, scale=None,
     lens, qs, ks = _expand_mask_operands(kv_lens, q_segments, kv_segments,
                                          B, H, Tqp, Tkp, true_tk=Tk)
 
+    single = n_k == 1
     extra, extra_specs = [], []
     if lens is not None:
         extra.append(lens)
         extra_specs.append(pl.BlockSpec(
-            lens.shape, lambda b, qi, ki: (0, 0),
+            lens.shape, lambda b, qi, ki=0: (0, 0),
             memory_space=pltpu.SMEM))
     if qs is not None:
         extra += [qs, ks]
-        extra_specs += [
-            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, qi, ki: (b, 0, ki)),
-        ]
+        if single:
+            extra_specs += [
+                pl.BlockSpec((1, block_q, 1), lambda b, qi: (b, qi, 0)),
+                pl.BlockSpec((1, 1, block_k), lambda b, qi: (b, 0, 0)),
+            ]
+        else:
+            extra_specs += [
+                pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+                pl.BlockSpec((1, 1, block_k), lambda b, qi, ki: (b, 0, ki)),
+            ]
 
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=Tk, seq_k_padded=Tkp, n_k=n_k,
-        has_lens=lens is not None, has_seg=qs is not None)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_k=Tk, seq_k_padded=Tkp,
+                  has_lens=lens is not None, has_seg=qs is not None)
+    if single:
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_single, **common),
+            grid=(B * H, n_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, Dp), lambda b, qi: (b, qi, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, qi: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, Dp), lambda b, qi: (b, 0, 0)),
+            ] + extra_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, Dp), lambda b, qi: (b, qi, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, qi: (b, qi, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, Tqp, Dp), q.dtype),
+                jax.ShapeDtypeStruct((B * H, Tqp, 1), jnp.float32),
+            ],
+            compiler_params=_compiler_params(pltpu,
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(qp, kp, vp, *extra)
+        out = out.reshape(B, H, Tqp, Dp)[:, :, :Tq, :D]
+        if return_lse:
+            return out, lse.reshape(B, H, Tqp)[:, :, :Tq]
+        return out
+
+    kernel = functools.partial(_fwd_kernel, n_k=n_k, **common)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, n_q, n_k),
@@ -316,7 +526,8 @@ def _pad_bshd(q, k, v, block_q, block_k):
 
 
 def pallas_flash_attention_bshd(q, k, v, causal=False, scale=None,
-                                block_q: int = 1024, block_k: int = 2048,
+                                block_q: Optional[int] = None,
+                                block_k: Optional[int] = None,
                                 interpret: bool = False,
                                 return_lse: bool = False, kv_lens=None):
     """Flash forward on (B, T, H, D) inputs — the layout Dense-projected
@@ -333,24 +544,60 @@ def pallas_flash_attention_bshd(q, k, v, causal=False, scale=None,
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else D ** -0.5
+    if block_q is None or block_k is None:
+        tq, tk = tune_attention_blocks(Tq, Tk, D, q.dtype)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
     qp, kp, vp, Tqp, Tkp, Dp = _pad_bshd(q, k, v, block_q, block_k)
     n_q = Tqp // block_q
     n_k = Tkp // block_k
 
+    single = n_k == 1
     extra, extra_specs = [], []
     if kv_lens is not None:
         lens = jnp.minimum(kv_lens.astype(jnp.int32), Tk).reshape(B, 1)
         extra.append(lens)
         extra_specs.append(pl.BlockSpec(
-            lens.shape, lambda b, h, qi, ki: (0, 0),
+            lens.shape, lambda b, h, qi, ki=0: (0, 0),
             memory_space=pltpu.SMEM))
 
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=Tk, seq_k_padded=Tkp, n_k=n_k,
-        has_lens=kv_lens is not None, has_seg=False, pid_off=1)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_k=Tk, seq_k_padded=Tkp,
+                  has_lens=kv_lens is not None, has_seg=False, pid_off=1)
+    if single:
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_single, **common),
+            grid=(B, H, n_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, Dp),
+                             lambda b, h, qi: (b, qi, h)),
+                pl.BlockSpec((1, block_k, Dp),
+                             lambda b, h, qi: (b, 0, h)),
+                pl.BlockSpec((1, block_k, Dp),
+                             lambda b, h, qi: (b, 0, h)),
+            ] + extra_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, Dp),
+                             lambda b, h, qi: (b, qi, h)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, qi: (b, h, qi, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, Tqp, H * Dp), q.dtype),
+                jax.ShapeDtypeStruct((B, H, Tqp, 1), jnp.float32),
+            ],
+            compiler_params=_compiler_params(pltpu,
+                dimension_semantics=("parallel", "parallel", "parallel")),
+            interpret=interpret,
+        )(qp, kp, vp, *extra)
+        out = out.reshape(B, Tqp, H, Dp)[:, :Tq, :, :D]
+        if return_lse:
+            return out, lse.reshape(B, H, Tqp)[:, :, :Tq]
+        return out
+
+    kernel = functools.partial(_fwd_kernel, n_k=n_k, **common)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
@@ -476,7 +723,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
     if has_lens:
         run = run & (ki * block_k < kvlen)
     _run_mask_specialized(pl, _compute, run, qi, ki, block_q, block_k,
-                          causal, has_lens, has_seg, needs_tail)
+                          causal, has_lens, has_seg, needs_tail,
+                          kvlen=kvlen, seq_k=seq_k)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -527,7 +775,8 @@ def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
     # through pT == 0.  The ladder still specializes causal full-blocks
     # to the mask-free path.
     _run_mask_specialized(pl, _compute, True, qi, ki, block_q, block_k,
-                          causal, has_lens, has_seg, needs_tail)
+                          causal, has_lens, has_seg, needs_tail,
+                          kvlen=kvlen, seq_k=seq_k)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -535,6 +784,45 @@ def _dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
             dk_ref.shape)
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype).reshape(
             dv_ref.shape)
+
+
+def _dqkv_single_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                        *rest, scale, causal, block_q, block_k, seq_k,
+                        seq_k_padded, has_lens, has_seg):
+    """Single-block backward (n_q == n_k == 1): the short-seq analogue of
+    ``_dqkv_fused_kernel``.  With the whole (Tq, Tk) extent resident as
+    one block there is no grid axis to stream over, so the dk/dv VMEM
+    accumulators and the init/finalize phases disappear — one score/dp
+    recompute, 5 dots, three direct output writes."""
+    import jax.experimental.pallas as pl
+
+    lens_ref, qseg_ref, kseg_ref, rest = _bwd_unpack(rest, has_lens, has_seg)
+    dq_ref, dk_ref, dv_ref = rest
+
+    kvlen = lens_ref[pl.program_id(0), 0] if has_lens else None
+    needs_tail = seq_k != seq_k_padded
+
+    def _compute(use_mask):
+        q, k, v, do, pT, dsT = _bwd_core(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qseg_ref,
+            kseg_ref, has_seg, use_mask, 0, 0, scale, causal,
+            block_q, block_k, seq_k, kvlen)
+        dv_ref[...] = lax.dot_general(
+            pT.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(
+                dv_ref.dtype).reshape(dv_ref.shape)
+        dq_ref[...] = lax.dot_general(
+            dsT.astype(q.dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(
+                dq_ref.dtype).reshape(dq_ref.shape)
+        dk_ref[...] = lax.dot_general(
+            dsT.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(
+                dk_ref.dtype).reshape(dk_ref.shape)
+
+    _run_mask_specialized(pl, _compute, True, 0, 0, block_q, block_k,
+                          causal, has_lens, has_seg, needs_tail,
+                          kvlen=kvlen, seq_k=seq_k)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
@@ -574,7 +862,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         # dk/dv of keys past the valid length are zero — skip the block
         run = run & (ki * block_k < kvlen)
     _run_mask_specialized(pl, _compute, run, qi, ki, block_q, block_k,
-                          causal, has_lens, has_seg, needs_tail)
+                          causal, has_lens, has_seg, needs_tail,
+                          kvlen=kvlen, seq_k=seq_k)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -585,8 +874,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
 
 
 def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
-                               scale=None, block_q: int = 1024,
-                               block_k: int = 2048, interpret: bool = False,
+                               scale=None, block_q: Optional[int] = None,
+                               block_k: Optional[int] = None,
+                               interpret: bool = False,
                                kv_lens=None, q_segments=None,
                                kv_segments=None):
     """Flash backward: (dq, dk, dv) without materialising (Tq, Tk)."""
@@ -596,6 +886,10 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else D ** -0.5
+    if block_q is None or block_k is None:
+        tq, tk = tune_attention_blocks(Tq, Tk, D, q.dtype)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
     if Tk <= block_k:
@@ -648,8 +942,47 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
         if lens is not None:
             fused_extra.append(lens)
             fused_especs.append(pl.BlockSpec(
-                lens.shape, lambda b, qi: (0, 0),
+                lens.shape, lambda b, qi=0: (0, 0),
                 memory_space=pltpu.SMEM))
+        if n_q == 1:
+            # short-seq fast path: the whole extent is one block — no
+            # q streaming, no dk/dv scratch accumulators (see
+            # _dqkv_single_kernel)
+            if qs_row is not None:
+                fused_extra += [qs_row, ks_col]
+                fused_especs += [
+                    pl.BlockSpec((1, 1, block_q), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, block_k, 1), lambda b: (b, 0, 0)),
+                ]
+            dq, dk, dv = pl.pallas_call(
+                functools.partial(_dqkv_single_kernel, **common),
+                grid=(B * H,),
+                in_specs=[
+                    pl.BlockSpec((1, block_q, Dp), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, block_k, Dp), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, block_k, Dp), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, block_q, Dp), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, 1, block_q), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, 1, block_q), lambda b: (b, 0, 0)),
+                ] + fused_especs,
+                out_specs=[
+                    pl.BlockSpec((1, block_q, Dp), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, block_k, Dp), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, block_k, Dp), lambda b: (b, 0, 0)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((B * H, Tqp, Dp), q.dtype),
+                    jax.ShapeDtypeStruct((B * H, Tkp, Dp), k.dtype),
+                    jax.ShapeDtypeStruct((B * H, Tkp, Dp), v.dtype),
+                ],
+                compiler_params=_compiler_params(pltpu,
+                    dimension_semantics=("parallel",)),
+                interpret=interpret,
+            )(qp, kp, vp, dop, lsep, dltp, *fused_extra)
+            dq = dq.reshape(B, H, Tqp, Dp)[:, :, :Tq, :D]
+            dk = dk.reshape(B, H, Tkp, Dp)[:, :, :Tk, :D]
+            dv = dv.reshape(B, H, Tkp, Dp)[:, :, :Tk, :D]
+            return dq, dk, dv
         if qs_row is not None:
             fused_extra += [qs_row, ks_col]
             fused_especs += [
@@ -763,8 +1096,8 @@ def pallas_flash_attention_bwd(q, k, v, out, lse, do, causal=False,
 
 
 def pallas_flash_attention_bwd_bshd(q, k, v, out, lse, do, causal=False,
-                                    scale=None, block_q: int = 1024,
-                                    block_k: int = 2048,
+                                    scale=None, block_q: Optional[int] = None,
+                                    block_k: Optional[int] = None,
                                     interpret: bool = False, kv_lens=None):
     """Flash backward on (B, T, H, D) operands (lse from the BSHD
     forward, (B, H, Tq)): (dq, dk, dv) in BSHD, no physical transposes —
@@ -775,6 +1108,10 @@ def pallas_flash_attention_bwd_bshd(q, k, v, out, lse, do, causal=False,
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else D ** -0.5
+    if block_q is None or block_k is None:
+        tq, tk = tune_attention_blocks(Tq, Tk, D, q.dtype)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
 
@@ -938,9 +1275,11 @@ def _reference_attention(q, k, v, causal, scale, kv_lens=None,
 
 
 def _flash_fwd(q, k, v, causal, scale, kv_lens, q_segments, kv_segments):
-    if _use_pallas(q, k, v):
+    plan = attention_dispatch(q.shape[2], k.shape[2], q.shape[3], q.dtype)
+    if plan["kernel"] != "dense_fallback":
         out, lse = pallas_flash_attention(
             q, k, v, causal=causal, scale=scale, return_lse=True,
+            block_q=plan["block_q"], block_k=plan["block_k"],
             kv_lens=kv_lens, q_segments=q_segments, kv_segments=kv_segments)
         return out, (q, k, v, out, lse, kv_lens, q_segments, kv_segments)
     out = _reference_attention(q, k, v, causal, scale, kv_lens, q_segments,
@@ -993,9 +1332,11 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None, kv_lens=None):
 
 
 def _flash_bshd_fwd(q, k, v, causal, scale, kv_lens):
-    if _use_pallas(q, k, v):
+    plan = attention_dispatch(q.shape[1], k.shape[1], q.shape[3], q.dtype)
+    if plan["kernel"] != "dense_fallback":
         out, lse = pallas_flash_attention_bshd(
             q, k, v, causal=causal, scale=scale, return_lse=True,
+            block_q=plan["block_q"], block_k=plan["block_k"],
             kv_lens=kv_lens)
         return out, (q, k, v, out, lse, kv_lens)
     bhtd = lambda x: jnp.swapaxes(x, 1, 2)

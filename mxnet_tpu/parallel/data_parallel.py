@@ -99,12 +99,28 @@ class DataParallelStep:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, donate=True,
-                 mirror=None):
+                 mirror=None, donate_batch=False):
         self._net = net
         self._loss = loss_fn
         self._opt = optimizer
         self._mesh = mesh if mesh is not None else get_mesh()
         self._donate = donate
+        # donate_batch additionally donates the data/label buffers: the
+        # step is their last reader (a fresh batch arrives every call),
+        # so XLA reuses their HBM pages for step outputs instead of
+        # holding them live — part of the pure-copy elimination.  Safety:
+        # buffers marked borrowed (``NDArray.mark_borrowed()`` — e.g. a
+        # batch a pipeline stage will hand out again) are passed as
+        # copies, and re-feeding a buffer a previous step donated raises
+        # instead of silently reading freed memory (on backends where
+        # donation is a no-op the raise is the only guard).
+        self._donate_batch = donate_batch
+        # ring of recently-donated batch buffers (strong refs keep the
+        # identity check stable; on TPU the donated shells are already
+        # freed device-side, so holding them is cheap) — bounded so a
+        # long training loop doesn't accumulate host-backed arrays
+        from collections import deque
+        self._donated_batch = deque(maxlen=64)
         self._mirror = _resolve_mirror(mirror)
         params = [p for _, p in sorted(net.collect_params().items())
                   if p._data is not None]
@@ -189,6 +205,18 @@ class DataParallelStep:
             if x is None:
                 return None
             val = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            if self._donate_batch:
+                if any(val is d for d in self._donated_batch):
+                    raise RuntimeError(
+                        "batch buffer was donated by a previous step "
+                        "(donate_batch=True) and may already be freed — "
+                        "feed a fresh batch, or mark_borrowed() buffers "
+                        "the caller keeps reusing")
+                if isinstance(x, NDArray) and getattr(x, "_borrowed",
+                                                      False):
+                    # opt-out: the caller still holds this buffer, so
+                    # donate a private copy instead of the original
+                    val = jnp.array(val, copy=True)
             if self._mesh is not None:
                 import jax.sharding as jsh
                 if scan:
@@ -261,6 +289,15 @@ class DataParallelStep:
         new_pvals, new_states, self._t_dev, self._rng_dev, loss = jfn(
             pvals, self._opt_states, self._t_dev, self._lrs_dev,
             self._rng_dev, dval, lval)
+        if self._donate_batch:
+            # remember this call's donated buffers so re-feeding one
+            # raises in prep — accumulated (not replaced) so a buffer
+            # donated several steps ago is still caught
+            self._donated_batch.extend(
+                d for d in (dval if isinstance(dval, tuple) else (dval,))
+                if d is not None)
+            if lval is not None:
+                self._donated_batch.append(lval)
         for p, v in zip(self._params, new_pvals):
             with autograd.pause():
                 p._data._data = v
@@ -367,6 +404,8 @@ class DataParallelStep:
             return new_pvals, new_states, t + 1, next_key, loss_val
 
         donate = (0, 1, 2, 4) if self._donate else ()
+        if self._donate_batch:
+            donate = donate + (5, 6)
         if not scan:
             return jax.jit(step_fn, donate_argnums=donate)
 

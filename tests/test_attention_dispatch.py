@@ -1,0 +1,191 @@
+"""Dispatch heuristic + short-sequence flash kernel tests.
+
+The dispatcher (``attention_dispatch``) picks short_seq / streaming /
+dense_fallback per shape; the short-seq kernel is the single-pass
+forward (no online-softmax streaming state) plus the no-scratch
+single-block dqkv backward.  Numerics run in interpret mode on CPU —
+the same kernels compile on a real TPU (bench.py attention records the
+dispatch choice and gates flash_speedup >= 1.0 at S=512 on-chip).
+"""
+import numpy as onp
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mxnet_tpu.ops import pallas_attention as P
+
+
+def _rand(shape, seed, dtype="float32"):
+    x = onp.random.RandomState(seed).uniform(-1, 1, shape).astype("float32")
+    return jnp.asarray(x, jnp.dtype(dtype))
+
+
+def _dense_masked(q, k, v, kv_lens=None, q_seg=None, kv_seg=None,
+                  causal=False):
+    d = q.shape[-1]
+    tq, tk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * d ** -0.5
+    mask = jnp.ones((q.shape[0], 1, tq, tk), bool)
+    if kv_lens is not None:
+        mask = mask & (jnp.arange(tk)[None, None, None, :]
+                       < kv_lens[:, None, None, None])
+    if q_seg is not None:
+        mask = mask & (q_seg[:, None, :, None] == kv_seg[:, None, None, :])
+    if causal:
+        mask = mask & (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.any(mask, axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+# --- dispatch heuristic ----------------------------------------------------
+
+def test_dispatch_dense_fallback_off_tpu():
+    # this suite runs on CPU: the public op must route dense
+    assert P.attention_dispatch(512, 512, 64)["kernel"] == "dense_fallback"
+
+
+def test_dispatch_table_on_tpu():
+    d = lambda s: P.attention_dispatch(s, s, 64, "bfloat16", on_tpu=True)
+    assert d(64)["kernel"] == "dense_fallback"      # tiny: dense wins
+    p512 = d(512)
+    assert p512["kernel"] == "short_seq"            # the BERT config shape
+    assert p512["block_k"] == 512                   # whole K axis, one block
+    assert d(384)["kernel"] == "short_seq"
+    assert d(4096)["kernel"] == "streaming"
+
+
+def test_dispatch_short_seq_blocks_cover_whole_k_axis():
+    for s in (128, 256, 384, 512, 1000):
+        plan = P.attention_dispatch(s, s, 64, "bfloat16", on_tpu=True)
+        if plan["kernel"] == "short_seq":
+            assert plan["block_k"] >= s
+
+
+def test_dispatch_never_exceeds_vmem_clamp():
+    """No dispatched kernel's padded blocks may exceed the VMEM clamp."""
+    for s in (128, 384, 512, 1024, 2048, 4096, 8192):
+        for d in (32, 64, 128, 256):
+            for dt in ("float32", "bfloat16"):
+                plan = P.attention_dispatch(s, s, d, dt, on_tpu=True)
+                if plan["kernel"] == "dense_fallback":
+                    continue
+                Dp = d + (-d) % 64
+                used = P._fwd_vmem_bytes(plan["block_q"], plan["block_k"],
+                                         Dp, jnp.dtype(dt).itemsize)
+                assert used <= P._VMEM_CLAMP, (s, d, dt, plan, used)
+
+
+# --- short-seq kernel numerics --------------------------------------------
+
+def _mask_operands(cfg, B, S, seed=99):
+    kv_lens = q_seg = kv_seg = None
+    causal = cfg == "causal"
+    if cfg == "kv_lens":
+        rs = onp.random.RandomState(seed)
+        kv_lens = jnp.asarray(rs.randint(S // 3, S + 1, (B,)), jnp.int32)
+    elif cfg == "segments":
+        seg = onp.zeros((B, S), onp.int32)
+        for b in range(B):
+            seg[b, (S // 3) * (b + 1):] = 1
+        q_seg = kv_seg = jnp.asarray(seg)
+    return causal, kv_lens, q_seg, kv_seg
+
+
+def _check_short_seq(S, cfg, dtype):
+    B, H, D = 2, 2, 64
+    q, k, v = (_rand((B, H, S, D), i, dtype) for i in range(3))
+    do = _rand((B, H, S, D), 7, dtype)
+    causal, kv_lens, q_seg, kv_seg = _mask_operands(cfg, B, S)
+    bq, bk = P.tune_attention_blocks(S, S, D, dtype)
+    assert bk >= S        # whole K axis: the single-pass kernel path
+    kw = dict(causal=causal, kv_lens=kv_lens, q_segments=q_seg,
+              kv_segments=kv_seg, interpret=True, block_q=bq, block_k=bk)
+    out, lse = P.pallas_flash_attention(q, k, v, return_lse=True, **kw)
+    dq, dk, dv = P.pallas_flash_attention_bwd(q, k, v, out, lse, do, **kw)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _dense_masked(a, b, c, kv_lens=kv_lens,
+                                      q_seg=q_seg, kv_seg=kv_seg,
+                                      causal=causal), q, k, v)
+    ref = _dense_masked(q, k, v, kv_lens=kv_lens, q_seg=q_seg,
+                        kv_seg=kv_seg, causal=causal)
+    rq, rk, rv = vjp(do)
+    tol = 0.06 if dtype == "bfloat16" else 5e-5
+    for name, got, want in (("out", out, ref), ("dq", dq, rq),
+                            ("dk", dk, rk), ("dv", dv, rv)):
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                    - want.astype(jnp.float32))))
+        assert err < tol, (name, S, cfg, dtype, err)
+
+
+def test_short_seq_kernel_numerics_fast():
+    """Tier-1 representative of the sweep below: non-power-of-two S with
+    kv_lens in fp32 (single-pass fwd + single-block dqkv bwd)."""
+    _check_short_seq(384, "kv_lens", "float32")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("cfg", ["causal", "kv_lens", "segments"])
+@pytest.mark.parametrize("S", [128, 384, 512])
+def test_short_seq_kernel_numerics(S, cfg, dtype):
+    _check_short_seq(S, cfg, dtype)
+
+
+def test_single_pass_fwd_matches_streaming_fwd():
+    """The single-pass kernel (block_k = whole axis) must agree with the
+    streaming kernel (block_k < axis) bit-for-fp32-bit."""
+    B, H, S, D = 2, 3, 256, 64
+    q, k, v = (_rand((B, H, S, D), 20 + i) for i in range(3))
+    o1, l1 = P.pallas_flash_attention(q, k, v, causal=True, return_lse=True,
+                                      interpret=True, block_q=128,
+                                      block_k=256)   # n_k=1: single-pass
+    o2, l2 = P.pallas_flash_attention(q, k, v, causal=True, return_lse=True,
+                                      interpret=True, block_q=128,
+                                      block_k=128)   # n_k=2: streaming
+    assert float(jnp.max(jnp.abs(o1 - o2))) < 2e-6
+    assert float(jnp.max(jnp.abs(l1 - l2))) < 2e-5
+
+
+def test_single_block_bwd_matches_fused_and_split():
+    """n_q == n_k == 1 routes the no-scratch single-block dqkv kernel;
+    it must match both the q-streaming fused kernel and the split
+    kernels."""
+    B, H, S, D = 2, 2, 128, 64
+    q, k, v, do = (_rand((B, H, S, D), 30 + i) for i in range(4))
+    kv_lens = jnp.asarray([128, 77], jnp.int32)
+    kw = dict(causal=False, kv_lens=kv_lens, interpret=True)
+    o, l = P.pallas_flash_attention(q, k, v, return_lse=True,
+                                    block_q=128, block_k=128, **kw)
+    g_single = P.pallas_flash_attention_bwd(q, k, v, o, l, do,
+                                            block_q=128, block_k=128, **kw)
+    g_fused = P.pallas_flash_attention_bwd(q, k, v, o, l, do,
+                                           block_q=64, block_k=128, **kw)
+    o2, l2 = P.pallas_flash_attention(q, k, v, return_lse=True,
+                                      block_q=64, block_k=64, **kw)
+    g_split = P.pallas_flash_attention_bwd(q, k, v, o2, l2, do,
+                                           block_q=64, block_k=64, **kw)
+    for a, b in zip(g_single, g_fused):
+        assert float(jnp.max(jnp.abs(a - b))) < 2e-5
+    for a, b in zip(g_single, g_split):
+        assert float(jnp.max(jnp.abs(a - b))) < 2e-5
+
+
+def test_full_block_predicate_with_kv_lens_matches_masked():
+    """Satellite fix: blocks wholly inside min(kv_lens) take the
+    mask-free fast path — results must be identical to the masked path
+    (exercised with lens that leave interior blocks fully visible)."""
+    B, H, S, D = 2, 2, 384, 32
+    q, k, v = (_rand((B, H, S, D), 40 + i) for i in range(3))
+    kv_lens = jnp.asarray([384, 300], jnp.int32)
+    out = P.pallas_flash_attention(q, k, v, interpret=True, block_q=128,
+                                   block_k=128, kv_lens=kv_lens)
+    ref = _dense_masked(q, k, v, kv_lens=kv_lens)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+    # and causal + lens combined (both predicates must hold at once)
+    out_c = P.pallas_flash_attention(q, k, v, causal=True, interpret=True,
+                                     block_q=128, block_k=128,
+                                     kv_lens=kv_lens)
+    ref_c = _dense_masked(q, k, v, kv_lens=kv_lens, causal=True)
+    assert float(jnp.max(jnp.abs(out_c - ref_c))) < 2e-5

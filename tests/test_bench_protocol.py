@@ -76,6 +76,51 @@ def test_sanity_gate_flags_kernel_error(bench):
     assert not bench._sanity_gates(details)
 
 
+def test_sanity_gate_flags_flash_slower_than_dense(bench):
+    """Dispatch contract: when a kernel (not the dense fallback) was
+    selected, flash losing to dense at ANY benched shape is flagged."""
+    d = {"bench": "attention", "shape": [8, 16, 512, 64],
+         "kernel": "short_seq", "flash_speedup": 0.93, "max_err_ok": True}
+    flags = bench._sanity_gates([d])
+    assert any("KERNEL REGRESSION" in f for f in flags)
+    assert not bench._sanity_gates([dict(d, flash_speedup=1.21)])
+    # off-chip (dense fallback dispatched): speedup is meaningless
+    assert not bench._sanity_gates(
+        [dict(d, kernel="dense_fallback", flash_speedup=0.5)])
+
+
+def test_hard_failures_gate_s512_speedup_and_numerics(bench):
+    """bench exits nonzero on max_err_ok:false anywhere, and on
+    flash_speedup < 1.0 at S=512 whenever a kernel ran on-chip."""
+    bad_err = {"bench": "attention", "shape": [8, 16, 2048, 64],
+               "kernel": "short_seq", "flash_speedup": 1.5,
+               "max_err": {"out": 0.5}, "max_err_ok": False}
+    assert bench._hard_failures([bad_err])
+    slow512 = {"bench": "attention", "shape": [8, 16, 512, 64],
+               "kernel": "short_seq", "flash_speedup": 0.9,
+               "max_err_ok": True}
+    assert bench._hard_failures([slow512])
+    # S=2048 below 1.0 is flagged by the sanity gate but is not a hard
+    # exit; S=512 via the dense fallback (off-chip) is not either
+    ok2048 = dict(slow512, shape=[8, 16, 2048, 64])
+    assert not bench._hard_failures([ok2048])
+    assert not bench._hard_failures([dict(slow512,
+                                          kernel="dense_fallback")])
+    good = dict(slow512, flash_speedup=1.3)
+    assert not bench._hard_failures([good])
+
+
+def test_attention_bench_records_dispatcher_choice(bench):
+    """The attention sweep ships the dispatcher's kernel choice (and its
+    block tuning) per shape so BENCH rounds can audit dispatch."""
+    out = bench.bench_attention(batch=1, heads=1, seqlen=64, head_dim=8,
+                                iters=1, inner=1, check_error=False)
+    assert out["kernel"] in ("short_seq", "streaming", "dense_fallback")
+    # this suite runs on CPU: the public op must have routed dense
+    assert out["kernel"] == "dense_fallback"
+    assert "block_q" in out and "block_k" in out
+
+
 def test_sanity_gate_flags_regression_vs_history(bench, tmp_path,
                                                  monkeypatch):
     hist = tmp_path / "BENCH_HISTORY.json"

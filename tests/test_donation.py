@@ -1,0 +1,137 @@
+"""Step-buffer donation semantics.
+
+DataParallelStep always donates params/opt-state/step-counter/RNG;
+``donate_batch=True`` additionally donates the data/label buffers (the
+step is their last reader in a pipelined loop).  Safety contract under
+test: re-feeding a donated buffer RAISES (instead of silently reading
+freed memory — on backends where donation is a no-op the raise is the
+only guard), and ``NDArray.mark_borrowed()`` opts a buffer out by
+donating a private copy.  Reference analogue: the engine's write-after-
+read dependency tracking that MXNet relies on for in-place update ops.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _tiny_step(donate_batch=False, seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    rs = onp.random.RandomState(seed)
+    x = mx.nd.array(rs.uniform(-1, 1, (8, 12)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 4, (8,)).astype("float32"))
+    net(x)
+    step = mx.parallel.DataParallelStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.SGD(learning_rate=0.1), mesh=None,
+        donate_batch=donate_batch)
+    return step, x, y, rs
+
+
+def _fresh_batch(rs):
+    return (mx.nd.array(rs.uniform(-1, 1, (8, 12)).astype("float32")),
+            mx.nd.array(rs.randint(0, 4, (8,)).astype("float32")))
+
+
+def test_default_batch_reuse_is_fine():
+    step, x, y, _ = _tiny_step(donate_batch=False)
+    l1 = float(step(x, y).asnumpy())
+    l2 = float(step(x, y).asnumpy())       # same buffers, no donation
+    assert l2 < l1
+
+
+def test_donated_then_reused_batch_raises():
+    step, x, y, rs = _tiny_step(donate_batch=True)
+    step(x, y)
+    with pytest.raises(RuntimeError, match="donated"):
+        step(x, y)                          # same buffer: must refuse
+
+
+def test_donated_batch_from_earlier_step_still_raises():
+    """The reuse guard remembers more than the last call: a buffer
+    donated several steps ago must still be refused."""
+    step, x, y, _ = _tiny_step(donate_batch=True)
+    step(x, y)
+    for _ in range(3):
+        x2, y2 = _fresh_batch(onp.random.RandomState(3))
+        step(x2, y2)
+    with pytest.raises(RuntimeError, match="donated"):
+        step(x, y)
+
+
+def test_donate_batch_fresh_batches_train():
+    step, x, y, rs = _tiny_step(donate_batch=True)
+    losses = [float(step(x, y).asnumpy())]
+    for _ in range(5):
+        x, y = _fresh_batch(onp.random.RandomState(0))
+        losses.append(float(step(x, y).asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_mark_borrowed_opts_buffer_out_of_donation():
+    step, x, y, _ = _tiny_step(donate_batch=True)
+    x.mark_borrowed()
+    y.mark_borrowed()
+    l1 = float(step(x, y).asnumpy())
+    l2 = float(step(x, y).asnumpy())       # copies were donated, not x/y
+    assert l2 < l1
+    # and the borrowed buffers are still readable by the caller
+    assert onp.isfinite(x.asnumpy()).all()
+
+
+def test_donated_tuple_batch_entries_tracked():
+    """Tuple-of-inputs steps track every donated leaf (None entries
+    allowed), so reuse of ANY element raises."""
+    mx.random.seed(1)
+
+    class TwoIn(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Dense(8)
+            self.b = nn.Dense(8)
+
+        def hybrid_forward(self, F, x, z):
+            return self.a(x) + self.b(z)
+
+    net = TwoIn()
+    net.initialize()
+    rs = onp.random.RandomState(1)
+    x = mx.nd.array(rs.uniform(-1, 1, (4, 6)).astype("float32"))
+    z = mx.nd.array(rs.uniform(-1, 1, (4, 6)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 8, (4,)).astype("float32"))
+    net(x, z)
+    step = mx.parallel.DataParallelStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.SGD(learning_rate=0.1), mesh=None, donate_batch=True)
+    step((x, z), y)
+    x2 = mx.nd.array(rs.uniform(-1, 1, (4, 6)).astype("float32"))
+    y2 = mx.nd.array(rs.randint(0, 8, (4,)).astype("float32"))
+    with pytest.raises(RuntimeError, match="donated"):
+        step((x2, z), y2)                   # z was donated last call
+
+
+def test_trainer_donate_grads_updates_weights():
+    """Trainer(donate_grads=True) threads gradient donation through the
+    fused update and keeps training correct."""
+    mx.random.seed(2)
+    net = nn.Dense(3)
+    net.initialize()
+    rs = onp.random.RandomState(2)
+    x = mx.nd.array(rs.uniform(-1, 1, (5, 4)).astype("float32"))
+    net(x)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5}, donate_grads=True)
+    w0 = net.weight.data().asnumpy().copy()
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(batch_size=5)
+    w1 = net.weight.data().asnumpy()
+    assert not onp.allclose(w0, w1)
+    assert onp.isfinite(w1).all()

@@ -1,0 +1,230 @@
+"""Fused BatchNorm→residual-add→ReLU epilogue tests.
+
+Kernels run in interpret mode on CPU; the custom-vjp wrapper's fallback
+path and the registered op / gluon layer / ResNet wiring are tested
+against the unfused composition (reference discipline:
+``check_consistency`` between the fused cuDNN BatchNormAddRelu and the
+composed ops).
+"""
+import numpy as onp
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ops import pallas_fused_norm as FN
+from mxnet_tpu.ops.nn import batch_norm, batch_norm_add_relu
+
+
+def _rand(shape, seed, dtype="float32"):
+    x = onp.random.RandomState(seed).uniform(-1, 1, shape).astype("float32")
+    return jnp.asarray(x, jnp.dtype(dtype))
+
+
+def _compose2d(x2d, s_row, t_row, r2d):
+    y = (x2d.astype(jnp.float32) * s_row + t_row
+         + r2d.astype(jnp.float32))
+    return jnp.maximum(y, 0.0).astype(x2d.dtype)
+
+
+def test_epilogue_fwd_kernel_matches_composition():
+    # odd rows/cols exercise both padding paths
+    rows, cols = 70, 200
+    x = _rand((rows, cols), 0)
+    r = _rand((rows, cols), 1)
+    s = _rand((1, cols), 2)
+    t = _rand((1, cols), 3)
+    y = FN.pallas_epilogue_fwd(x, s, t, r, interpret=True)
+    ref = _compose2d(x, s, t, r)
+    assert float(jnp.max(jnp.abs(y - ref))) < 1e-6
+
+
+def test_epilogue_bwd_kernel_matches_vjp():
+    rows, cols = 48, 384       # multiple row blocks via small block pick
+    x = _rand((rows, cols), 10)
+    r = _rand((rows, cols), 11)
+    s = _rand((1, cols), 12)
+    t = _rand((1, cols), 13)
+    ct = _rand((rows, cols), 14)
+    y = FN.pallas_epilogue_fwd(x, s, t, r, interpret=True)
+    dx, dr, ds, dt = FN.pallas_epilogue_bwd(x, s, y, ct, interpret=True)
+    _, vjp = jax.vjp(_compose2d, x, s, t, r)
+    rx, rs, rt, rr = vjp(ct)
+    assert float(jnp.max(jnp.abs(dx - rx))) < 1e-5
+    assert float(jnp.max(jnp.abs(dr - rr))) < 1e-5
+    assert float(jnp.max(jnp.abs(ds - rs))) < 1e-4
+    assert float(jnp.max(jnp.abs(dt - rt))) < 1e-4
+
+
+def test_fused_scale_shift_add_relu_fallback_grads():
+    """Off-TPU the custom-vjp wrapper runs the jnp path; grads for all
+    four operands must match plain autodiff of the composition."""
+    rows, cols = 32, 128
+    x = _rand((rows, cols), 20)
+    r = _rand((rows, cols), 21)
+    s = _rand((cols,), 22)
+    t = _rand((cols,), 23)
+
+    def fused_loss(x, s, t, r):
+        return jnp.sum(FN.fused_scale_shift_add_relu(x, s, t, r) ** 2)
+
+    def ref_loss(x, s, t, r):
+        return jnp.sum(_compose2d(x, s.reshape(1, -1),
+                                  t.reshape(1, -1), r) ** 2)
+
+    got = jax.grad(fused_loss, argnums=(0, 1, 2, 3))(x, s, t, r)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2, 3))(x, s, t, r)
+    for g1, g2 in zip(got, want):
+        assert float(jnp.max(jnp.abs(g1 - g2))) < 1e-4
+
+
+def test_nd_entry_nchw_and_nhwc_match_composition():
+    """fused_bn_add_relu_epilogue collapses channel+trailing dims into
+    lanes for ANY axis — NCHW (axis=1) and NHWC (axis=3) must agree with
+    the broadcast composition."""
+    x = _rand((2, 6, 5, 7), 30)
+    r = _rand((2, 6, 5, 7), 31)
+    for axis in (1, 3):
+        C = x.shape[axis]
+        s = _rand((C,), 32)
+        t = _rand((C,), 33)
+        shp = [1] * 4
+        shp[axis] = C
+        ref = jnp.maximum(x * s.reshape(shp) + t.reshape(shp) + r, 0.0)
+        got = FN.fused_bn_add_relu_epilogue(x, s, t, r, axis)
+        assert float(jnp.max(jnp.abs(got - ref))) < 1e-5
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_bn_add_relu_op_matches_unfused_composition(dtype):
+    """The registered op == BatchNorm → add → relu, fwd AND bwd."""
+    x = _rand((4, 8, 6, 6), 40, dtype)
+    res = _rand((4, 8, 6, 6), 41, dtype)
+    gamma = _rand((8,), 42)
+    beta = _rand((8,), 43)
+    mm = jnp.zeros((8,), jnp.float32)
+    mv = jnp.ones((8,), jnp.float32)
+    kw = dict(eps=1e-5, fix_gamma=False, training=True)
+
+    def fused(x, res, gamma, beta):
+        return batch_norm_add_relu(x, res, gamma, beta, mm, mv, **kw)
+
+    def composed(x, res, gamma, beta):
+        out, mean, var = batch_norm(x, gamma, beta, mm, mv, **kw)
+        return jnp.maximum(out + res, 0.0), mean, var
+
+    o1, m1, v1 = fused(x, res, gamma, beta)
+    o2, m2, v2 = composed(x, res, gamma, beta)
+    # the fused epilogue holds f32 through the whole tail while the
+    # composed path casts scale/shift to data dtype first — rounding-
+    # level disagreement, not an error
+    tol = 2e-2 if dtype == "bfloat16" else 1e-4
+    assert float(jnp.max(jnp.abs(o1.astype(jnp.float32)
+                                 - o2.astype(jnp.float32)))) < tol
+    assert float(jnp.max(jnp.abs(m1 - m2))) == 0.0
+    assert float(jnp.max(jnp.abs(v1 - v2))) == 0.0
+
+    g1 = jax.grad(lambda *a: jnp.sum(fused(*a)[0].astype(jnp.float32) ** 2),
+                  argnums=(0, 1, 2, 3))(x, res, gamma, beta)
+    g2 = jax.grad(lambda *a: jnp.sum(composed(*a)[0].astype(jnp.float32)
+                                     ** 2),
+                  argnums=(0, 1, 2, 3))(x, res, gamma, beta)
+    # relative comparison: the squared-sum loss makes |grad| large, and
+    # the two paths accumulate bf16-rounded terms in different orders —
+    # gamma/beta grads sum ~B*H*W such terms, so allow a few percent
+    gtol = 0.05 if dtype == "bfloat16" else 1e-4
+    for a, b in zip(g1, g2):
+        b32 = b.astype(jnp.float32)
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b32)))
+        assert err / (1.0 + float(jnp.max(jnp.abs(b32)))) < gtol
+
+
+def test_batchnorm_add_relu_layer_matches_composed_layers():
+    """The gluon layer == nn.BatchNorm + add + relu, including the
+    moving-stats update and the backward through mx autograd."""
+    mx.random.seed(0)
+    bn = nn.BatchNorm(in_channels=4)
+    fused = nn.BatchNormAddReLU(in_channels=4)
+    bn.initialize()
+    fused.initialize()
+    rs = onp.random.RandomState(5)
+    # identical (non-trivial) affine params on both layers
+    g = rs.uniform(0.5, 1.5, (4,)).astype("float32")
+    b = rs.uniform(-1, 1, (4,)).astype("float32")
+    for layer in (bn, fused):
+        layer.gamma.set_data(mx.nd.array(g))
+        layer.beta.set_data(mx.nd.array(b))
+    x = mx.nd.array(rs.uniform(-1, 1, (3, 4, 5, 5)).astype("float32"))
+    r = mx.nd.array(rs.uniform(-1, 1, (3, 4, 5, 5)).astype("float32"))
+    x1, r1 = x.copy(), r.copy()
+    x.attach_grad()
+    r.attach_grad()
+    x1.attach_grad()
+    r1.attach_grad()
+    with autograd.record():
+        y = fused(x, r)
+    y.backward()
+    with autograd.record():
+        yref = mx.nd.relu(bn(x1) + r1)
+    yref.backward()
+    assert onp.abs(y.asnumpy() - yref.asnumpy()).max() < 1e-5
+    assert onp.abs(x.grad.asnumpy() - x1.grad.asnumpy()).max() < 1e-5
+    assert onp.abs(r.grad.asnumpy() - r1.grad.asnumpy()).max() < 1e-5
+    # moving stats advanced identically
+    assert onp.abs(fused.running_mean.data().asnumpy()
+                   - bn.running_mean.data().asnumpy()).max() < 1e-6
+    assert onp.abs(fused.running_var.data().asnumpy()
+                   - bn.running_var.data().asnumpy()).max() < 1e-6
+
+
+def test_resnet_v1_blocks_use_fused_epilogue():
+    """Acceptance: the bench path (resnet50_v1 and friends) ends every
+    v1 residual body with the fused BN+add+relu layer, at the SAME
+    structural position/name a plain BatchNorm had."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import (BasicBlockV1,
+                                                         BottleneckV1)
+    for cls in (BasicBlockV1, BottleneckV1):
+        blk = cls(64, 1, downsample=True, in_channels=32)
+        tail = list(blk.body)[-1]
+        assert isinstance(tail, nn.BatchNormAddReLU)
+    net = mx.gluon.model_zoo.vision.resnet50_v1(classes=10)
+    tails = [list(unit.body)[-1]
+             for stage in list(net.features)[4:8] for unit in stage]
+    assert tails and all(isinstance(t, nn.BatchNormAddReLU)
+                         for t in tails)
+
+
+def test_fused_residual_net_train_eval_consistency():
+    """End-to-end: a stack of the actual fused ResNet v1 units trains
+    (loss descends through autograd + Trainer) and the eval path (moving
+    stats through the fused op's use_global branch) stays finite.  (The
+    eager autograd/Trainer loop, not a donated DataParallelStep: a
+    donated conv-net step jit trips a pre-existing jax-CPU persistent-
+    cache deserialization bug unrelated to the epilogue — the donated
+    on-chip resnet50 path is covered by bench.py.)"""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(BottleneckV1(16, 1, downsample=True, in_channels=3))
+    net.add(BottleneckV1(16, 1, False, in_channels=16))
+    net.add(nn.GlobalAvgPool2D(), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.uniform(size=(2, 3, 16, 16)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 10, (2,)).astype("float32"))
+    net(x)        # materialize deferred shapes
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05})
+    losses = []
+    for _ in range(7):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(batch_size=2)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+    out = net(x)      # eval path (moving stats)
+    assert onp.isfinite(out.asnumpy()).all()

@@ -169,10 +169,13 @@ def test_pipeline_shuffle_deterministic(rec_file):
     assert onp.array_equal(outs[0][1], outs[1][1])
 
 
+@pytest.mark.slow
 def test_pipeline_thread_count_invariant(rec_file):
     """Per-image work stealing must be schedule-independent: any thread
     count yields bit-identical batches (augment RNG is keyed on (seed,
-    epoch, record position), not on worker assignment)."""
+    epoch, record position), not on worker assignment).  slow: a full
+    worker-count sweep (3 epochs of the rec) — the single-config borrow/
+    release and u8 parity tests below keep tier-1 coverage."""
     f = native.NativeRecordFile(rec_file["jrec"])
     offs = f.scan()
     f.close()

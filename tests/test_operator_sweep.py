@@ -602,6 +602,9 @@ COVERED_ELSEWHERE = {
     # test_rnn.py (RNN), test_gluon.py (layers), test_symbol.py /
     # test_module.py (output ops), test_amp.py (amp_cast), test_loss.py,
     # test_autograd.py (BlockGrad/stop_gradient), test_control_flow.py
+    # BatchNormAddRelu: fused BN->add->ReLU epilogue, fwd+bwd covered by
+    # tests/test_fused_bn_epilogue.py
+    "BatchNormAddRelu", "_contrib_BatchNormAddRelu",
     "Activation", "BatchNorm", "BatchNorm_v1", "BlockGrad",
     "BlockGrad_inner", "Cast", "Convolution", "Convolution_v1",
     "Deconvolution", "Dropout", "Embedding", "Flatten", "FullyConnected",
