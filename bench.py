@@ -509,28 +509,13 @@ def bench_input_pipeline_isolated():
                        "(rc=%d): %s" % (res.returncode, res.stderr[-400:]))
 
 
-def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
-               arch="base", padded=True, pipelined_k=0, head="masked"):
-    """BERT pretraining-style train step (BASELINE.json config 5): MLM loss
-    over a bert_base encoder whose attention runs in the Pallas flash
-    kernel; fwd+loss+bwd+Adam as one donated XLA program.
-
-    ``padded=True`` feeds realistic per-row valid lengths (the normal BERT
-    batch shape) — the padding mask runs INSIDE the flash kernel's online
-    softmax, so this measures the masked fused path, not a mask-free
-    idealization.  tokens_per_sec counts all (padded) positions, matching
-    how the reference reports throughput.
-
-    ``head="masked"`` (the default, and the reference pretraining shape:
-    GluonNLP's BERTModel decodes only ``masked_positions``) gathers the
-    standard 15% of positions before the vocab projection, so the MLM
-    head costs B*P rows instead of B*S.  ``head="full"`` decodes every
-    position — profiling showed the full-decode softmax/CE over
-    (B*S, 30522) was ~45% of the step's device time, all of it work the
-    reference pipeline never does."""
-    if pipelined_k and not padded:
-        raise ValueError("bench_bert pipelined_k requires padded=True "
-                         "(the scan stacks per-row valid lengths)")
+def _build_bert_step(batch_size=24, seq_len=512, dtype="bfloat16",
+                     arch="base", padded=True, head="masked"):
+    """Construct the bert_mlm_train step: returns ``(run, step, info)``
+    where ``run()`` executes one train step and ``info`` carries the
+    host-side tensors the pipelined leg restacks.  Shared by
+    ``bench_bert`` and ``bench_telemetry_overhead`` (the A/B leg must
+    time the SAME compiled step)."""
     if head not in ("masked", "full"):
         raise ValueError("head must be 'masked' or 'full', got %r" % head)
     import numpy as onp
@@ -593,6 +578,40 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
         run = lambda: step((tokens, None, None, vl, pos), labels)
     else:
         run = lambda: step(tokens, labels)
+    info = {"vocab": vocab, "n_pred": n_pred, "n_lab": n_lab, "rs": rs,
+            "host_vl": host_vl, "host_pos": host_pos}
+    return run, step, info
+
+
+def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
+               arch="base", padded=True, pipelined_k=0, head="masked"):
+    """BERT pretraining-style train step (BASELINE.json config 5): MLM loss
+    over a bert_base encoder whose attention runs in the Pallas flash
+    kernel; fwd+loss+bwd+Adam as one donated XLA program.
+
+    ``padded=True`` feeds realistic per-row valid lengths (the normal BERT
+    batch shape) — the padding mask runs INSIDE the flash kernel's online
+    softmax, so this measures the masked fused path, not a mask-free
+    idealization.  tokens_per_sec counts all (padded) positions, matching
+    how the reference reports throughput.
+
+    ``head="masked"`` (the default, and the reference pretraining shape:
+    GluonNLP's BERTModel decodes only ``masked_positions``) gathers the
+    standard 15% of positions before the vocab projection, so the MLM
+    head costs B*P rows instead of B*S.  ``head="full"`` decodes every
+    position — profiling showed the full-decode softmax/CE over
+    (B*S, 30522) was ~45% of the step's device time, all of it work the
+    reference pipeline never does."""
+    if pipelined_k and not padded:
+        raise ValueError("bench_bert pipelined_k requires padded=True "
+                         "(the scan stacks per-row valid lengths)")
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    run, step, info = _build_bert_step(batch_size, seq_len, dtype, arch,
+                                       padded, head)
+    vocab, n_pred, n_lab = info["vocab"], info["n_pred"], info["n_lab"]
+    rs, host_vl, host_pos = info["rs"], info["host_vl"], info["host_pos"]
     # the first few calls recompile as donation settles buffer layouts
     step_s, loss, timing = _time_calls(run, _sync, warmup=4, iters=iters)
     out = {"bench": "bert_mlm_train", "arch": arch,
@@ -627,6 +646,46 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
             K * batch_size * seq_len / scan_s, 1)
         out["pipelined_timing"] = scan_timing
     return out
+
+
+def bench_telemetry_overhead(batch_size=24, seq_len=512, dtype="bfloat16",
+                             iters=10, arch="base"):
+    """A/B of the SAME compiled bert_mlm_train step with telemetry OFF
+    vs ON (spans + step hooks + recompile detector + memory-gauge
+    stride all live).  Telemetry is host-side only — the compiled
+    program is identical — so the honest overhead is the host dispatch
+    delta.  ``overhead_pct`` > 2 is a HARD bench failure
+    (_hard_failures): the always-on layer must stay effectively free.
+    Negative deltas are timing noise and clamp to 0."""
+    from mxnet_tpu import telemetry
+
+    run, _, _ = _build_bert_step(batch_size, seq_len, dtype, arch)
+    with telemetry.disabled():
+        off_s, _, off_t = _time_calls(run, _sync, warmup=4, iters=iters)
+    # NO reset here: earlier bench jobs' telemetry must survive into the
+    # artifact's telemetry_snapshot — count this leg's spans as a delta.
+    # The ON leg force-enables telemetry: under MXNET_TELEMETRY=0 the
+    # gate would otherwise silently measure disabled-vs-disabled.
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        before = telemetry.snapshot(events=0)["spans"].get(
+            "parallel.step", {}).get("count", 0)
+        on_s, _, on_t = _time_calls(run, _sync, warmup=2, iters=iters)
+        snap = telemetry.snapshot(events=0)
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    overhead = max(0.0, (on_s - off_s) / off_s * 100.0)
+    return {"bench": "telemetry_overhead", "arch": arch,
+            "batch_size": batch_size, "seq_len": seq_len, "dtype": dtype,
+            "step_ms_telemetry_off": round(off_s * 1000, 3),
+            "step_ms_telemetry_on": round(on_s * 1000, 3),
+            "overhead_pct": round(overhead, 3),
+            "overhead_ok": overhead <= 2.0,
+            "timing_off": off_t, "timing_on": on_t,
+            "telemetry_span_count": snap["spans"].get(
+                "parallel.step", {}).get("count", 0) - before}
 
 
 def bench_ssd(batch_size=32, image_size=128, iters=8):
@@ -842,6 +901,8 @@ def main():
         jobs.append(lambda: bench_ssd(iters=max(4, args.iters // 3)))
         jobs.append(lambda: bench_ssd(batch_size=16, image_size=224,
                                       iters=max(4, args.iters // 3)))
+        jobs.append(lambda: bench_telemetry_overhead(
+            iters=max(6, args.iters // 2)))
         jobs.append(bench_input_pipeline_isolated)
     else:
         # the default run covers every BASELINE.json config (the driver
@@ -893,6 +954,8 @@ def main():
         jobs.append(lambda: bench_ssd(iters=max(4, it // 3)))
         jobs.append(lambda: bench_ssd(batch_size=16, image_size=224,
                                       iters=max(4, it // 3)))
+        # always-on telemetry must stay <= 2% on the hot step (hard gate)
+        jobs.append(lambda: bench_telemetry_overhead(iters=max(6, it // 2)))
         # input pipeline (rec -> host -> device -> step legs) — in a FRESH
         # subprocess: after ~14 jobs this process's accumulated jax
         # runtime threads strangle the 1-core decode pool (measured 84
@@ -924,6 +987,17 @@ def main():
     for f in flags:
         print("# SANITY: %s" % f, file=sys.stderr)
     _update_history(details)
+
+    # embed the run's telemetry in the artifact (the in-process snapshot
+    # API): span aggregates, compile/retrace counts, donation/dispatch
+    # counters — the observability record next to the numbers
+    from mxnet_tpu import telemetry
+    tsnap = telemetry.snapshot(events=0)
+    details.append({"bench": "telemetry_snapshot",
+                    "spans": tsnap["spans"],
+                    "counters": tsnap["counters"],
+                    "gauges": tsnap["gauges"],
+                    "compiles": tsnap["compiles"]})
 
     headline = None
     for d in details:  # headline: the BASELINE train target, bf16 bs128
@@ -979,12 +1053,18 @@ def _hard_failures(details):
         chip, so every throughput number in the artifact is suspect;
       * ``flash_speedup < 1.0`` at S=512 when a kernel (not the dense
         fallback) was dispatched — the round-5 regression shape; the
-        dispatcher exists precisely so this shape never loses to dense.
+        dispatcher exists precisely so this shape never loses to dense;
+      * ``telemetry_overhead`` > 2% — the always-on telemetry layer's
+        whole contract is that it is too cheap to ever turn off.
     """
     hard = []
     for d in details:
         if not isinstance(d, dict):
             continue
+        if d.get("bench") == "telemetry_overhead" \
+                and d.get("overhead_ok") is False:
+            hard.append("telemetry overhead %.2f%% > 2%% on the "
+                        "bert_mlm_train step" % d.get("overhead_pct", 0))
         if d.get("max_err_ok") is False:
             hard.append("max_err_ok false: %s %s max_err=%s"
                         % (d.get("bench"), d.get("shape"),

@@ -36,6 +36,7 @@ if _os.environ.get("MXNET_TPU_COORDINATOR_ADDRESS"):
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 
+from . import telemetry
 from . import base
 from . import context
 from . import ndarray
@@ -85,5 +86,5 @@ __all__ = [
     "optimizer", "opt", "lr_scheduler", "metric", "kvstore", "kv",
     "io", "recordio", "image", "parallel", "profiler", "symbol", "sym",
     "executor", "model", "module", "mod", "callback", "contrib",
-    "monitor", "visualization", "viz", "runtime", "engine",
+    "monitor", "visualization", "viz", "runtime", "engine", "telemetry",
 ]

@@ -1,6 +1,25 @@
 """Training callbacks (reference ``python/mxnet/callback.py``):
 Speedometer, do_checkpoint, ProgressBar, LogValidationMetricsCallback,
-module_checkpoint.
+module_checkpoint — driven by the runtime telemetry layer.
+
+Two ways to run a callback:
+
+* the reference path — pass it as ``batch_end_callback`` to
+  ``Module.fit`` (it receives the usual ``BatchEndParam``);
+* the telemetry path — ``cb.attach()`` registers it on the telemetry
+  step hook, so it fires on every ``Trainer.step()`` /
+  ``DataParallelStep`` call with no training-loop plumbing at all.
+
+Either way ``Speedometer`` enriches its line from the telemetry
+snapshot: per-step wall time from the step span and the prefetch ring
+occupancy, so a log line shows WHERE a slow epoch went (compute vs a
+starved input pipeline)::
+
+    Epoch[0] Batch [50-100]\tSpeed: 1234.56 samples/sec\t\
+step-ms=12.345\tring=3/4\taccuracy=0.912000
+
+``tools/parse_log.py`` parses this format (and the telemetry JSONL
+sink) back into per-epoch tables.
 """
 from __future__ import annotations
 
@@ -8,14 +27,87 @@ import logging
 import math
 import time
 
+from . import telemetry
+
 __all__ = ["Speedometer", "ProgressBar", "do_checkpoint",
            "module_checkpoint", "log_train_metric",
            "LogValidationMetricsCallback"]
 
+# step-span names in priority order: the finest-grained one with data
+# wins (a Trainer drives parallel steps too, but trainer.step wraps the
+# whole update so it is the user-facing number)
+_STEP_SPANS = ("trainer.step", "parallel.step", "module.step")
 
-class Speedometer:
-    """Logs samples/sec and metrics every ``frequent`` batches
-    (reference callback.py Speedometer)."""
+def _telemetry_suffix():
+    """``\tstep-ms=...\tring=o/d`` from the live telemetry snapshot —
+    empty string when telemetry is off or has no step data yet."""
+    if not telemetry.enabled():
+        return ""
+    snap = telemetry.snapshot(events=0)
+    parts = []
+    for name in _STEP_SPANS:
+        agg = snap["spans"].get(name)
+        if agg:
+            parts.append("step-ms=%.3f" % agg["last_ms"])
+            break
+    occ = snap["gauges"].get("prefetch.ring_occupancy")
+    depth = snap["gauges"].get("prefetch.ring_depth")
+    if occ is not None and depth:
+        parts.append("ring=%d/%d" % (occ, depth))
+    return ("\t" + "\t".join(parts)) if parts else ""
+
+
+class _AttachableCallback:
+    """Mixin: ``attach()`` installs the callback on the telemetry step
+    hook (fires per ``Trainer.step``/``DataParallelStep`` call);
+    ``detach()`` removes it.  Trainer/parallel step records carry no
+    epoch (those loops don't know epochs) — a loop that wants per-epoch
+    log lines calls ``set_epoch(e)`` at its epoch boundary; Module.fit
+    records carry their real epoch and ignore the hint."""
+
+    _hook = None
+    _epoch_hint = 0
+
+    def set_epoch(self, epoch):
+        """Epoch used for step records that carry none (the
+        trainer/parallel attach paths).  Call at epoch boundaries."""
+        self._epoch_hint = int(epoch)
+        return self
+
+    def attach(self, source=None):
+        """Install on the telemetry step hook.  ``source`` filters to
+        one emitter ('trainer', 'parallel', 'module'); default:
+        'trainer' events, falling back to 'parallel' ones when no
+        Trainer is in the loop (only one fires per training setup)."""
+        if self._hook is not None:
+            return self
+
+        def _hook(rec):
+            src = rec.get("source")
+            if source is not None:
+                if src != source:
+                    return
+            elif src not in ("trainer", "parallel", "module"):
+                return
+            # the SAME payload type the Module.fit path delivers, so
+            # __call__ implementations never see two divergent shapes
+            from .model import BatchEndParam
+            param = BatchEndParam(epoch=rec.get("epoch", self._epoch_hint),
+                                  nbatch=rec.get("index", 0),
+                                  eval_metric=None, locals=None)
+            self(param)
+        self._hook = telemetry.add_step_hook(_hook)
+        return self
+
+    def detach(self):
+        if self._hook is not None:
+            telemetry.remove_step_hook(self._hook)
+            self._hook = None
+
+
+class Speedometer(_AttachableCallback):
+    """Logs samples/sec, telemetry step time and ring occupancy every
+    ``frequent`` batches (reference callback.py Speedometer)."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -37,26 +129,29 @@ class Speedometer:
                         time.time() - self.tic)
                 except ZeroDivisionError:
                     speed = float("inf")
+                extra = _telemetry_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset_local()
                     msg = "Epoch[%d] Batch [%d-%d]\tSpeed: %.2f samples/sec"
+                    msg += extra.replace("%", "%%")
                     msg += "\t%s=%f" * len(name_value)
                     logging.info(msg, param.epoch, count - self.frequent,
                                  count, speed,
                                  *sum(name_value, ()))
                 else:
                     logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
+                        "Epoch[%d] Batch [%d-%d]\tSpeed: %.2f samples/sec%s",
+                        param.epoch, count - self.frequent, count, speed,
+                        extra.replace("%", "%%"))
                 self.tic = time.time()
         else:
             self.init = True
             self.tic = time.time()
 
 
-class ProgressBar:
+class ProgressBar(_AttachableCallback):
     """ASCII progress bar over the epoch (reference callback.py)."""
 
     def __init__(self, total, length=80):
@@ -80,7 +175,9 @@ def do_checkpoint(prefix, period=1):
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
             from .model import save_checkpoint
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            with telemetry.span("checkpoint.save"):
+                save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            telemetry.event("checkpoint", prefix, epoch=iter_no + 1)
     return _callback
 
 
@@ -91,7 +188,10 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            with telemetry.span("checkpoint.save"):
+                mod.save_checkpoint(prefix, iter_no + 1,
+                                    save_optimizer_states)
+            telemetry.event("checkpoint", prefix, epoch=iter_no + 1)
     return _callback
 
 

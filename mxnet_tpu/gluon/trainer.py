@@ -16,6 +16,7 @@ import numpy as onp
 
 from .. import autograd
 from .. import kvstore as kvs
+from .. import telemetry
 from .. import optimizer as opt
 from ..optimizer.optimizer import pin_update_dtypes as _pin_update_dtypes
 from ..base import MXNetError
@@ -107,6 +108,13 @@ class _FusedUpdate:
                tuple((w.shape, str(w.dtype)) for w in weights))
         jfn = self._cache.get(key)
         if jfn is None:
+            telemetry.record_compile(
+                "FusedUpdate[%x]" % id(self),
+                {"indices": list(indices),
+                 "hyperparams": dict(fingerprint),
+                 "wds": list(key[2]),
+                 "weights": [{"shape": list(w.shape),
+                              "dtype": str(w.dtype)} for w in weights]})
             try:
                 steps = [optimizer.make_step(i) for i in indices]
             except NotImplementedError:
@@ -156,6 +164,8 @@ class _FusedUpdate:
         new_w, new_s = jfn(wvals, gvals, svals,
                            jnp.asarray(optimizer.num_update, jnp.int32),
                            jnp.asarray(lrs, jnp.float32))
+        if self._donate_grads:
+            telemetry.inc("donation.grad_buffers", len(gvals))
         with autograd.pause():
             for w, nv in zip(weights, new_w):
                 w._data = nv
@@ -220,6 +230,7 @@ class Trainer:
         self._donate_grads = donate_grads
         self._kv_fused = None
         self._local_fused = None
+        self._step_count = 0
         self._reset_kvstore()
 
     def _init_optimizer(self, optimizer, optimizer_params):
@@ -318,7 +329,21 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step over recorded gradients (reference
-        trainer.py:305)."""
+        trainer.py:305).  The step runs inside a telemetry span (per-step
+        wall time + a memory gauge at the boundary) and fires the
+        registered step hooks — Monitor/Speedometer attach there instead
+        of requiring manual tic/toc."""
+        # memory sampled on a stride (first step always): the allocator
+        # query is a runtime call, not worth paying on every fast step
+        with telemetry.span("trainer.step",
+                            memory=(self._step_count % 8 == 0)) as _sp:
+            self._step_impl(batch_size, ignore_stale_grad)
+        telemetry.emit_step("trainer", self._step_count,
+                            batch_size=batch_size,
+                            step_ms=_sp.duration_ms, owner=self)
+        self._step_count += 1
+
+    def _step_impl(self, batch_size, ignore_stale_grad):
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:

@@ -35,10 +35,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import weakref
 
 import numpy as onp
 
+from .. import telemetry
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["DevicePrefetchIter"]
@@ -274,7 +276,9 @@ class DevicePrefetchIter(DataIter):
             if it is None:
                 return
             try:
+                t0 = time.perf_counter()
                 host = it._next_host()
+                host_s = time.perf_counter() - t0
             except StopIteration:
                 it = None
                 put((_END, None))
@@ -286,7 +290,22 @@ class DevicePrefetchIter(DataIter):
             if stop.is_set():               # drop the in-flight batch
                 return
             try:
+                t0 = time.perf_counter()
                 shipped = it._ship(*host)
+                ship_s = time.perf_counter() - t0
+                # per-stage rate gauges: host decode (rec -> staged
+                # numpy) and ship (device_put dispatch + on-device
+                # normalize dispatch) img/s for the LAST batch — the
+                # numbers the bench sweep derives, now live at runtime
+                n = host[0].shape[0]
+                telemetry.observe("prefetch.host", host_s)
+                telemetry.observe("prefetch.ship", ship_s)
+                if host_s > 0:
+                    telemetry.gauge("prefetch.host_rate_img_s",
+                                    round(n / host_s, 1))
+                if ship_s > 0:
+                    telemetry.gauge("prefetch.ship_rate_img_s",
+                                    round(n / ship_s, 1))
             except Exception as e:
                 it = None
                 put((_ERR, e))
@@ -355,7 +374,18 @@ class DevicePrefetchIter(DataIter):
     def next(self):
         if self._exhausted or self._q is None:
             raise StopIteration
+        # ring occupancy BEFORE the blocking get: 0 here means the
+        # consumer is about to stall on the pipeline (the "stalled
+        # prefetch ring" signature); depth alongside so occupancy reads
+        # as a fraction
+        telemetry.gauge("prefetch.ring_occupancy", self._q.qsize())
+        telemetry.gauge("prefetch.ring_depth", self._depth)
+        t0 = time.perf_counter()
         kind, payload = self._q.get()
+        telemetry.observe("prefetch.consumer_wait",
+                          time.perf_counter() - t0)
+        if kind == _BATCH:
+            telemetry.inc("prefetch.batches")
         if kind == _END:
             self._exhausted = True
             raise StopIteration

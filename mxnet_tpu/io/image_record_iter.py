@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
 import numpy as onp
 
+from .. import telemetry
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["ImageRecordIter"]
@@ -142,12 +144,14 @@ class _NativeImageRecordIter(DataIter):
         the ambient context and pull back)."""
         if self._exhausted:
             raise StopIteration
+        t0 = time.perf_counter()
         out = self._pipe.next()
         if out is None:
             self._exhausted = True
             raise StopIteration
         data, labels, pad, errors = out
-        self._warn_errors(errors)
+        self._account(data.shape[0] - pad, errors,
+                      time.perf_counter() - t0)
         label = labels[:, 0] if self.label_width == 1 else labels
         return data, label, pad
 
@@ -159,18 +163,26 @@ class _NativeImageRecordIter(DataIter):
         then releases the slot back to the worker pool."""
         if self._exhausted:
             raise StopIteration
+        t0 = time.perf_counter()
         out = self._pipe.next_borrow()
         if out is None:
             self._exhausted = True
             raise StopIteration
         data, labels, pad, errors, token = out
-        self._warn_errors(errors)
+        self._account(data.shape[0] - pad, errors,
+                      time.perf_counter() - t0)
         label = labels[:, 0] if self.label_width == 1 else labels
         return data, label, pad, lambda: self._pipe.release(token)
 
-    @staticmethod
-    def _warn_errors(errors):
+    def _account(self, records, errors, wait_s):
+        """Per-batch telemetry for the native worker pool: batch/record
+        counters, decode-error counter, and the consumer's wait on the
+        C++ prefetcher (0 ≈ decode keeps up; large = decode-bound)."""
+        telemetry.inc("io.batches")
+        telemetry.inc("io.records", records)
+        telemetry.observe("io.batch_wait", wait_s)
         if errors:
+            telemetry.inc("io.decode_errors", errors)
             logging.warning(
                 "ImageRecordIter: %d undecodable records in batch "
                 "(zero image, label -1 — mask labels < 0 to exclude)",

@@ -13,6 +13,7 @@ import logging
 import time
 
 from .. import metric as metric_mod
+from .. import telemetry
 from ..model import BatchEndParam
 
 
@@ -162,8 +163,11 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                with telemetry.span("module.step") as _sp:
+                    self.forward_backward(data_batch)
+                    self.update()
+                telemetry.emit_step("module", nbatch, epoch=epoch,
+                                    step_ms=_sp.duration_ms, owner=self)
                 try:
                     next_data_batch = next(data_iter)
                 except StopIteration:

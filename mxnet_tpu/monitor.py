@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import re
 
+from . import telemetry
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -44,6 +45,62 @@ class Monitor:
         self.re_prog = re.compile(pattern)
         self.sort = sort
         self.monitor_all = monitor_all
+        self._hook = None
+        self._attached = []
+
+    def attach(self, trainer):
+        """Drive this monitor from the telemetry step hook: every
+        ``trainer.step()`` fires the hook, so no manual ``tic``/``toc``
+        bracketing is needed.  Stats are collected from the trainer's
+        parameters (names matched against ``pattern``; gradients added
+        under ``monitor_all``) on the due interval and logged like
+        ``toc_print``.  Returns ``self`` for chaining."""
+        if trainer not in self._attached:
+            self._attached.append(trainer)
+        if self._hook is None:
+            def _hook(rec):
+                if rec.get("source") != "trainer" or \
+                        rec.get("owner") not in self._attached:
+                    return
+                self.tic()
+                if not self.activated:
+                    return
+                res = self._collect_trainer(rec["owner"], rec["index"])
+                self.activated = False
+                self.queue = []
+                for n, k, v_ in res:
+                    logging.info("Batch: %7d %30s %s", n, k, v_)
+            self._hook = telemetry.add_step_hook(_hook)
+        return self
+
+    def detach(self):
+        """Remove the telemetry step hook installed by :meth:`attach`."""
+        if self._hook is not None:
+            telemetry.remove_step_hook(self._hook)
+            self._hook = None
+        self._attached = []
+
+    def _collect_trainer(self, trainer, step_idx):
+        """[(step, name, stat_str)] over a Trainer's params (and grads
+        under ``monitor_all``), pattern-filtered like the executor
+        path."""
+        res = []
+
+        def visit(name, arr):
+            if arr is None or not self.re_prog.match(name):
+                return
+            v = self.stat_func(arr)
+            if isinstance(v, NDArray):
+                v = v.asnumpy()
+            res.append((step_idx, name, str(v)))
+        for p in trainer._params:
+            visit(p.name, p.data() if p._data is not None else None)
+            if self.monitor_all and p.grad_req != "null" \
+                    and p._grad is not None:
+                visit(p.name + "_grad", p.grad())
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
 
     def install(self, target):
         """Attach to a Module or Executor (reference install_to_executor).

@@ -105,12 +105,21 @@ def attention_dispatch(seq_q, seq_k, head_dim, dtype="bfloat16",
     (min(Tq, Tk) < _DENSE_MIN_SEQ) go dense, Tk <= _SHORT_SEQ_MAX_TK
     single-pass, longer streams.  Chosen blocks always satisfy the VMEM
     clamp (tune_attention_blocks)."""
+    from .. import telemetry
     if on_tpu is None:
         on_tpu = _use_pallas()
     if not on_tpu or min(seq_q, seq_k) < _DENSE_MIN_SEQ:
+        telemetry.inc("attention.kernel.dense_fallback")
         return {"kernel": "dense_fallback", "block_q": None, "block_k": None}
     block_q, block_k = tune_attention_blocks(seq_q, seq_k, head_dim, dtype)
     kernel = "short_seq" if seq_k <= block_k else "streaming"
+    # per-shape dispatch accounting: this runs at TRACE time (once per
+    # compiled shape, not per step), so the journal is a census of which
+    # kernel every shape in the run got
+    telemetry.inc("attention.kernel.%s" % kernel)
+    telemetry.event("attention_dispatch", kernel, seq_q=int(seq_q),
+                    seq_k=int(seq_k), head_dim=int(head_dim),
+                    dtype=str(dtype), block_q=block_q, block_k=block_k)
     return {"kernel": kernel, "block_q": block_q, "block_k": block_k}
 
 

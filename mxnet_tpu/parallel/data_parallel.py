@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from .. import telemetry
 from ..optimizer import optimizer as _opt
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap
@@ -201,6 +202,18 @@ class DataParallelStep:
         """Shared prologue/epilogue for the per-call and scan paths:
         batch placement, compile-cache lookup, lr/step/RNG refresh, and
         the parameter/opt-state writeback."""
+        # memory is sampled on a stride, not per step: device
+        # memory_stats() is a runtime call, and this step is the hot
+        # path the 2% telemetry-overhead gate protects
+        idx = self._t   # 0-based index of THIS step (inner advances it)
+        with telemetry.span("parallel.step",
+                            memory=(idx % 32 == 0)) as _sp:
+            out = self._dispatch_inner(data, label, scan)
+        telemetry.emit_step("parallel", idx, step_ms=_sp.duration_ms,
+                            owner=self)
+        return out
+
+    def _dispatch_inner(self, data, label, scan):
         def prep(x):
             if x is None:
                 return None
@@ -255,6 +268,22 @@ class DataParallelStep:
                else sig(dval), sig(lval))
         jfn = self._cache.get(key)
         if jfn is None:
+            # cache miss = an XLA retrace; report the structured key so
+            # the recompile detector can name the shape/dtype/mode that
+            # moved (a silent retrace storm is the dominant hidden cost
+            # on this backend)
+            sig_d = lambda v: (None if v is None
+                               else {"shape": list(v.shape),
+                                     "dtype": str(v.dtype)})
+            # per-INSTANCE detector key: first compiles of unrelated
+            # steps (a bench builds ~10) must not read as retraces of
+            # one function and trip the warning on each other
+            telemetry.record_compile(
+                "DataParallelStep[%x]" % id(self),
+                {"mode": "scan" if scan else "call",
+                 "data": ([sig_d(d) for d in dval]
+                          if isinstance(dval, tuple) else sig_d(dval)),
+                 "label": sig_d(lval)})
             jfn = self._build(scan=scan)
             self._cache[key] = jfn
         self._t += lead
@@ -293,11 +322,13 @@ class DataParallelStep:
             # remember this call's donated buffers so re-feeding one
             # raises in prep — accumulated (not replaced) so a buffer
             # donated several steps ago is still caught
-            self._donated_batch.extend(
-                d for d in (dval if isinstance(dval, tuple) else (dval,))
-                if d is not None)
+            donated = [d for d in (dval if isinstance(dval, tuple)
+                                   else (dval,)) if d is not None]
+            self._donated_batch.extend(donated)
             if lval is not None:
                 self._donated_batch.append(lval)
+                donated.append(lval)
+            telemetry.inc("donation.batch_buffers", len(donated))
         for p, v in zip(self._params, new_pvals):
             with autograd.pause():
                 p._data._data = v

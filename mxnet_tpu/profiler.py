@@ -18,6 +18,8 @@ import time
 
 import jax
 
+from . import telemetry
+
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "Domain", "Task", "Frame", "Event", "Counter",
            "Marker", "profiler_set_config", "profiler_set_state",
@@ -33,7 +35,7 @@ _CONFIG = {
     "profile_api": True,
     "aggregate_stats": False,
 }
-_STATE = {"running": False, "dir": None}
+_STATE = {"running": False, "paused": False, "dir": None}
 
 
 def set_config(**kwargs):
@@ -68,32 +70,52 @@ profiler_set_state = set_state
 
 
 def state():
+    # a paused capture is still logically in the 'run' state (the
+    # reference's pause does not change the profiler state machine)
     return "run" if _STATE["running"] else "stop"
 
 
 def start():
-    """Begin trace capture (reference profiler.start)."""
+    """Begin trace capture (reference profiler.start).  Starting while
+    paused resumes the SAME capture (same trace dir) — previously
+    ``set_state('run')`` on a paused capture double-started a fresh
+    trace over the paused one."""
     if _STATE["running"]:
+        if _STATE["paused"]:
+            resume()
         return
     d = _trace_dir()
     jax.profiler.start_trace(d)
-    _STATE.update(running=True, dir=d)
+    _STATE.update(running=True, paused=False, dir=d)
+    telemetry.event("profiler", "start", dir=d)
 
 
 def stop():
     """End trace capture (reference profiler.stop)."""
     if not _STATE["running"]:
         return
-    jax.profiler.stop_trace()
-    _STATE["running"] = False
+    if not _STATE["paused"]:     # a paused capture's trace is already off
+        jax.profiler.stop_trace()
+    _STATE.update(running=False, paused=False)
+    telemetry.event("profiler", "stop", dir=_STATE["dir"])
 
 
 def pause(profile_process="worker"):
-    stop()
+    """Suspend the underlying trace without leaving the 'run' state
+    (reference profiler.pause)."""
+    if _STATE["running"] and not _STATE["paused"]:
+        jax.profiler.stop_trace()
+        _STATE["paused"] = True
+        telemetry.event("profiler", "pause")
 
 
 def resume(profile_process="worker"):
-    start()
+    """Resume a paused capture into the same trace dir (reference
+    profiler.resume)."""
+    if _STATE["running"] and _STATE["paused"]:
+        jax.profiler.start_trace(_STATE["dir"])
+        _STATE["paused"] = False
+        telemetry.event("profiler", "resume")
 
 
 def dump(finished=True, profile_process="worker"):
@@ -136,20 +158,31 @@ class Domain:
 
 class _Span:
     """start/stop scope emitting a TraceAnnotation (the engine's
-    opr_profile hook analogue, threaded_engine.h:85)."""
+    opr_profile hook analogue, threaded_engine.h:85) AND a telemetry
+    span — the object model is live even when no XLA capture runs:
+    durations land in ``telemetry.snapshot()`` and the journal."""
 
     def __init__(self, domain, name):
         self.domain = domain
         self.name = name
         self._ann = None
+        self._tspan = None
+
+    def _label(self):
+        return "%s::%s" % (self.domain.name, self.name) if self.domain \
+            else self.name
 
     def start(self):
-        label = "%s::%s" % (self.domain.name, self.name) if self.domain \
-            else self.name
+        label = self._label()
         self._ann = jax.profiler.TraceAnnotation(label)
         self._ann.__enter__()
+        self._tspan = telemetry.span("profiler.%s" % label)
+        self._tspan.__enter__()
 
     def stop(self):
+        if self._tspan is not None:
+            self._tspan.__exit__(None, None, None)
+            self._tspan = None
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
@@ -182,8 +215,9 @@ class Event(_Span):
 
 
 class Counter:
-    """Numeric counter object (reference profiler.py Counter).  Values are
-    recorded as trace instant annotations."""
+    """Numeric counter object (reference profiler.py Counter).  Every
+    mutation mirrors into a telemetry gauge (counters here may go down,
+    so they map to gauges) named ``profiler.<domain>.<name>``."""
 
     def __init__(self, domain, name, value=None):
         self.domain = domain
@@ -192,14 +226,21 @@ class Counter:
         if value is not None:
             self.set_value(value)
 
+    def _publish(self):
+        telemetry.gauge("profiler.%s.%s" % (self.domain.name, self.name),
+                        self._value)
+
     def set_value(self, value):
         self._value = value
+        self._publish()
 
     def increment(self, delta=1):
         self._value += delta
+        self._publish()
 
     def decrement(self, delta=1):
         self._value -= delta
+        self._publish()
 
     def get_value(self):
         return self._value
@@ -224,6 +265,8 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
+        telemetry.event("marker", "%s::%s" % (self.domain.name, self.name),
+                        scope=scope)
         with jax.profiler.TraceAnnotation(
                 "%s::%s" % (self.domain.name, self.name)):
             pass

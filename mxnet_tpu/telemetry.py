@@ -1,0 +1,518 @@
+"""Always-on runtime telemetry: spans, counters, gauges, event journal.
+
+The reference ships engine-level per-op instrumentation as a first-class
+subsystem (``src/profiler/profiler.h:88`` chrome://tracing JSON, executor
+monitor callbacks, ``mxnet.callback.Speedometer``); under XLA the ops
+fuse into a handful of programs, so the observable seams move to the
+HOST side — step dispatch, compile-cache lookups, input-pipeline stages,
+buffer donation — and that is exactly what this module instruments.
+
+Everything here is host-side and cheap (a ``perf_counter`` pair and a
+few dict writes per record, no device sync, no allocation on the hot
+path beyond one small dict), so it stays ON in production runs; the
+``MXNET_TELEMETRY=0`` env kills it to a near-no-op for A/B overhead
+measurement (``bench.py telemetry_overhead`` gates the delta at 2%).
+
+Primitives
+----------
+* ``span(name)`` — ``with telemetry.span("step"): ...`` scoped wall-time
+  timer; aggregates (count/total/min/max/last) live in the snapshot and
+  each completed span appends a journal event.
+* ``inc(name, delta)`` / ``counter(name)`` — monotonic counters.
+* ``gauge(name, value)`` — last-value gauges (ring occupancy, RSS, ...).
+* ``event(kind, name, **data)`` — structured entry in the bounded
+  journal (a ``deque(maxlen=...)``: old events fall off, memory stays
+  bounded no matter how long the run).
+* ``record_compile(fn, key)`` — the recompile detector: every jit-cache
+  miss reports its cache key here; the detector diffs it against the
+  function's previous key and journals WHICH leaf moved
+  (``data.shape[0]: 8 -> 16``), warning on the Nth retrace (the
+  dominant silent cost on XLA backends is exactly this).
+* ``sample_memory()`` — gauges for device ``memory_stats()`` bytes and
+  host RSS; sampled automatically at ``span(..., memory=True)``
+  boundaries (the trainer step does this).
+
+Exporters
+---------
+* ``snapshot()`` — in-process dict (counters, gauges, span aggregates,
+  compile counts, recent events); ``bench.py`` embeds it in BENCH
+  artifacts.
+* ``export_chrome_trace(path)`` — chrome://tracing JSON of the journal's
+  spans/counters; written next to a ``jax.profiler`` capture it gives
+  the host-side timeline alongside the XLA device trace.
+* ``export_jsonl(path)`` / ``set_jsonl_sink(path)`` — one-shot dump or
+  streaming append of journal events as JSON lines
+  (``tools/parse_log.py`` parses them back into tables).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "span", "observe", "inc", "counter", "gauge", "event", "snapshot",
+    "reset", "enabled", "enable", "disable", "disabled",
+    "record_compile", "compile_counts", "sample_memory",
+    "add_step_hook", "remove_step_hook", "emit_step",
+    "export_chrome_trace", "export_jsonl", "set_jsonl_sink",
+    "JOURNAL_MAXLEN",
+]
+
+JOURNAL_MAXLEN = int(os.environ.get("MXNET_TELEMETRY_JOURNAL", "4096"))
+# warn once a function's compile count reaches this (each retrace of a
+# hot jitted step costs seconds-to-minutes of XLA compile time)
+_RETRACE_WARN = int(os.environ.get("MXNET_TELEMETRY_RETRACE_WARN", "3"))
+
+_EPOCH = time.perf_counter()     # monotonic anchor for trace timestamps
+_WALL0 = time.time()             # wall-clock at the anchor
+
+_lock = threading.Lock()
+_enabled = os.environ.get("MXNET_TELEMETRY", "1") not in ("0", "false",
+                                                          "off")
+_counters = {}
+_gauges = {}
+_spans = {}          # name -> [count, total_s, min_s, max_s, last_s]
+_journal = deque(maxlen=JOURNAL_MAXLEN)
+_compiles = {}       # fn -> {"count": int, "key": last_key}
+_step_hooks = []
+_jsonl = {"path": None, "fh": None}
+
+
+def _now():
+    return time.perf_counter() - _EPOCH
+
+
+# ---------------------------------------------------------------------------
+# enable / disable
+# ---------------------------------------------------------------------------
+
+def enabled():
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+class disabled:
+    """``with telemetry.disabled(): ...`` — A/B overhead measurement."""
+
+    def __enter__(self):
+        self._prev = _enabled
+        disable()
+        return self
+
+    def __exit__(self, *a):
+        if self._prev:
+            enable()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+def _emit(rec):
+    """Append to the journal (and the streaming JSONL sink, if set).
+    Caller holds no lock; rec must already carry ``ts``."""
+    with _lock:
+        _journal.append(rec)
+        fh = _jsonl["fh"]
+        if fh is not None:
+            try:
+                # default=str: a non-JSON value (numpy scalar, device
+                # array) degrades to its string form instead of raising
+                # out of the training step
+                fh.write(json.dumps(rec, default=str) + "\n")
+            except (ValueError, OSError):    # closed/unwritable sink
+                _jsonl["fh"] = None
+
+
+def event(kind, name, **data):
+    """Record a structured event in the bounded journal."""
+    if not _enabled:
+        return
+    rec = {"ts": round(_WALL0 + _now(), 6), "kind": kind, "name": name}
+    if data:
+        rec.update(data)
+    _emit(rec)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def _record_span(name, start, dur_s, journal=True):
+    with _lock:
+        agg = _spans.get(name)
+        if agg is None:
+            _spans[name] = [1, dur_s, dur_s, dur_s, dur_s]
+        else:
+            agg[0] += 1
+            agg[1] += dur_s
+            agg[2] = min(agg[2], dur_s)
+            agg[3] = max(agg[3], dur_s)
+            agg[4] = dur_s
+    if journal:
+        _emit({"ts": round(_WALL0 + start, 6), "kind": "span",
+               "name": name, "dur_ms": round(dur_s * 1e3, 4),
+               "tid": threading.get_ident()})
+
+
+class _Span:
+    """Scoped wall-time timer.  ``duration_ms`` is readable after exit."""
+
+    __slots__ = ("name", "memory", "_t0", "duration_ms")
+
+    def __init__(self, name, memory=False):
+        self.name = name
+        self.memory = memory
+        self._t0 = None
+        self.duration_ms = None
+
+    def __enter__(self):
+        self._t0 = _now()
+        return self
+
+    def __exit__(self, *a):
+        dur = _now() - self._t0
+        self.duration_ms = dur * 1e3
+        _record_span(self.name, self._t0, dur)
+        if self.memory:
+            sample_memory()
+        return False
+
+
+class _NoopSpan:
+    __slots__ = ("duration_ms",)
+    name = None
+    memory = False
+
+    def __enter__(self):
+        self.duration_ms = None
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def span(name, memory=False):
+    """``with telemetry.span("step"): ...`` — time a scope."""
+    if not _enabled:
+        return _NoopSpan()
+    return _Span(name, memory=memory)
+
+
+def observe(name, dur_s):
+    """Record an externally-measured duration into the span aggregates
+    (for stages timed by hand, e.g. inside the prefetch feeder loop)."""
+    if not _enabled:
+        return
+    _record_span(name, _now() - dur_s, dur_s, journal=False)
+
+
+# ---------------------------------------------------------------------------
+# counters / gauges
+# ---------------------------------------------------------------------------
+
+def inc(name, delta=1):
+    """Bump a monotonic counter."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + delta
+
+
+def counter(name):
+    """Current value of a counter (0 if never bumped)."""
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def gauge(name, value):
+    """Set a last-value gauge."""
+    if not _enabled:
+        return
+    with _lock:
+        _gauges[name] = value
+
+
+# ---------------------------------------------------------------------------
+# recompile detector
+# ---------------------------------------------------------------------------
+
+def _diff_keys(old, new, path=""):
+    """Leaf-level diff of two (nested dict/tuple/list/scalar) cache keys.
+    Returns human-readable ``path: old -> new`` strings — the axis (or
+    dtype, or static arg) that forced the retrace."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        out = []
+        for k in sorted(set(old) | set(new)):
+            p = "%s.%s" % (path, k) if path else str(k)
+            if k not in old:
+                out.append("%s: <absent> -> %r" % (p, new[k]))
+            elif k not in new:
+                out.append("%s: %r -> <absent>" % (p, old[k]))
+            else:
+                out.extend(_diff_keys(old[k], new[k], p))
+        return out
+    if isinstance(old, (tuple, list)) and isinstance(new, (tuple, list)):
+        if len(old) != len(new):
+            return ["%s: %r -> %r" % (path or "key", tuple(old),
+                                      tuple(new))]
+        out = []
+        for i, (o, n) in enumerate(zip(old, new)):
+            out.extend(_diff_keys(o, n, "%s[%d]" % (path, i)))
+        return out
+    if old != new:
+        return ["%s: %r -> %r" % (path or "key", old, new)]
+    return []
+
+
+def record_compile(fn, key):
+    """Report a jit-cache miss for ``fn`` with its cache key.
+
+    The first compile is journaled as ``kind="compile"``; every later
+    one as ``kind="recompile"`` with ``changed`` naming exactly which
+    leaf of the key moved vs the previous compile.  On the
+    ``MXNET_TELEMETRY_RETRACE_WARN``-th (default 3rd) compile of the
+    same function a ``logging`` warning fires — a retrace storm on a
+    hot step usually means an unstable shape/dtype/static-arg upstream.
+    """
+    if not _enabled:
+        return None
+    with _lock:
+        ent = _compiles.get(fn)
+        if ent is None:
+            ent = _compiles[fn] = {"count": 0, "key": None}
+        ent["count"] += 1
+        n = ent["count"]
+        prev = ent["key"]
+        ent["key"] = key
+    if prev is None:
+        event("compile", fn, n=n)
+        return []
+    changed = _diff_keys(prev, key) or ["<cache key unchanged>"]
+    event("recompile", fn, n=n, changed=changed)
+    if n >= _RETRACE_WARN:
+        logging.warning(
+            "telemetry: %s compiled %d times (retrace); last change: %s",
+            fn, n, "; ".join(changed[:4]))
+    return changed
+
+
+def compile_counts():
+    with _lock:
+        return {k: v["count"] for k, v in _compiles.items()}
+
+
+# ---------------------------------------------------------------------------
+# memory gauge
+# ---------------------------------------------------------------------------
+
+def _host_rss_bytes():
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+_LIVE_BUFFERS = os.environ.get("MXNET_TELEMETRY_LIVE_BUFFERS",
+                               "0") not in ("0", "false", "off")
+
+
+def sample_memory():
+    """Gauge the device allocator and host RSS.  Device ``memory_stats``
+    is absent on some backends (CPU) — those gauges are simply skipped;
+    host RSS is always available on Linux.  With
+    ``MXNET_TELEMETRY_LIVE_BUFFERS=1`` the sum of live jax array bytes
+    is gauged too (enumerating live buffers is not free, so it is
+    opt-in)."""
+    if not _enabled:
+        return
+    rss = _host_rss_bytes()
+    if rss is not None:
+        gauge("mem.host_rss_bytes", rss)
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    except Exception:
+        stats = None
+    if stats:
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                gauge("mem.device_%s" % k, int(stats[k]))
+    if _LIVE_BUFFERS:
+        try:
+            import jax
+            gauge("mem.live_buffer_bytes",
+                  int(sum(a.nbytes for a in jax.live_arrays())))
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# step hooks
+# ---------------------------------------------------------------------------
+
+def add_step_hook(hook):
+    """Register ``hook(record)`` to fire after every training step
+    (``Trainer.step`` / ``DataParallelStep`` / ``Module.fit``).  The
+    record is a dict: ``source``, ``index``, plus whatever the emitter
+    attached (``batch_size``, ``step_ms``, ``owner``...).  This is how
+    ``Monitor.attach`` and ``Speedometer.attach`` install themselves
+    without manual tic/toc."""
+    with _lock:
+        if hook not in _step_hooks:
+            _step_hooks.append(hook)
+    return hook
+
+
+def remove_step_hook(hook):
+    with _lock:
+        if hook in _step_hooks:
+            _step_hooks.remove(hook)
+
+
+def emit_step(source, index, **data):
+    """Fire the step hooks (and journal a ``step`` event)."""
+    if not _enabled:
+        return
+    rec = {"source": source, "index": index}
+    rec.update(data)
+    event("step", source, index=index,
+          **{k: v for k, v in data.items()
+             if isinstance(v, (int, float, str, bool, type(None)))})
+    with _lock:
+        hooks = list(_step_hooks)
+    for h in hooks:
+        try:
+            h(rec)
+        except Exception:        # a broken observer must not kill training
+            logging.exception("telemetry: step hook %r failed", h)
+
+
+# ---------------------------------------------------------------------------
+# snapshot / reset
+# ---------------------------------------------------------------------------
+
+def snapshot(events=64):
+    """In-process view of everything: counters, gauges, span aggregates
+    (ms), compile counts, and the ``events`` most recent journal
+    entries.  Cheap enough to embed per-run in BENCH artifacts."""
+    with _lock:
+        spans = {
+            name: {"count": a[0],
+                   "total_ms": round(a[1] * 1e3, 3),
+                   "mean_ms": round(a[1] / a[0] * 1e3, 3),
+                   "min_ms": round(a[2] * 1e3, 3),
+                   "max_ms": round(a[3] * 1e3, 3),
+                   "last_ms": round(a[4] * 1e3, 3)}
+            for name, a in _spans.items()}
+        return {
+            "enabled": _enabled,
+            "counters": dict(_counters),
+            "gauges": dict(_gauges),
+            "spans": spans,
+            "compiles": {k: v["count"] for k, v in _compiles.items()},
+            "events": list(_journal)[-events:] if events else [],
+        }
+
+
+def reset():
+    """Clear all telemetry state (tests, bench A/B legs)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _spans.clear()
+        _journal.clear()
+        _compiles.clear()
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def set_jsonl_sink(path):
+    """Stream every subsequent journal event to ``path`` as JSON lines
+    (append mode).  ``None`` closes the sink."""
+    with _lock:
+        if _jsonl["fh"] is not None:
+            try:
+                _jsonl["fh"].close()
+            except OSError:
+                pass
+        _jsonl["fh"] = open(path, "a") if path else None
+        _jsonl["path"] = path
+
+
+def export_jsonl(path):
+    """One-shot dump: the journal plus a final ``snapshot`` record."""
+    snap = snapshot(events=0)
+    with _lock:
+        events = list(_journal)
+    with open(path, "w") as f:
+        for rec in events:
+            f.write(json.dumps(rec, default=str) + "\n")
+        f.write(json.dumps({"ts": round(_WALL0 + _now(), 6),
+                            "kind": "snapshot",
+                            "counters": snap["counters"],
+                            "gauges": snap["gauges"],
+                            "spans": snap["spans"],
+                            "compiles": snap["compiles"]},
+                           default=str) + "\n")
+    return path
+
+
+def export_chrome_trace(path=None):
+    """Write the journal as chrome://tracing JSON.
+
+    Spans become complete (``ph:"X"``) events on their recording
+    thread; counters at export time become one ``ph:"C"`` sample;
+    compile/recompile/step events become instants.  Default path:
+    ``telemetry.trace.json`` inside the profiler's trace dir, so the
+    file lands next to a ``jax.profiler`` capture and the two open in
+    the same viewer (host timeline + device timeline)."""
+    if path is None:
+        from . import profiler as _prof
+        path = os.path.join(_prof._trace_dir(), "telemetry.trace.json")
+    pid = os.getpid()
+    out = []
+    with _lock:
+        events = list(_journal)
+        counters = dict(_counters)
+    for rec in events:
+        ts_us = (rec["ts"] - _WALL0) * 1e6
+        if rec["kind"] == "span":
+            out.append({"name": rec["name"], "ph": "X", "pid": pid,
+                        "tid": rec.get("tid", 0), "ts": ts_us,
+                        "dur": rec.get("dur_ms", 0) * 1e3,
+                        "cat": "telemetry"})
+        else:
+            args = {k: v for k, v in rec.items()
+                    if k not in ("ts", "kind", "name")}
+            out.append({"name": "%s:%s" % (rec["kind"], rec["name"]),
+                        "ph": "i", "s": "p", "pid": pid,
+                        "tid": rec.get("tid", 0), "ts": ts_us,
+                        "cat": "telemetry", "args": args})
+    ts_us = _now() * 1e6
+    for name, val in counters.items():
+        out.append({"name": name, "ph": "C", "pid": pid, "ts": ts_us,
+                    "args": {"value": val}})
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": out,
+                   "displayTimeUnit": "ms"}, f, default=str)
+    return path

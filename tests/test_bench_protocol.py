@@ -110,6 +110,18 @@ def test_hard_failures_gate_s512_speedup_and_numerics(bench):
     assert not bench._hard_failures([good])
 
 
+def test_hard_failures_gate_telemetry_overhead(bench):
+    """The always-on telemetry layer's 2% overhead budget is a hard
+    bench failure, not a soft flag."""
+    bad = {"bench": "telemetry_overhead", "overhead_pct": 3.5,
+           "overhead_ok": False}
+    assert any("telemetry overhead" in h
+               for h in bench._hard_failures([bad]))
+    good = {"bench": "telemetry_overhead", "overhead_pct": 0.4,
+            "overhead_ok": True}
+    assert not bench._hard_failures([good])
+
+
 def test_attention_bench_records_dispatcher_choice(bench):
     """The attention sweep ships the dispatcher's kernel choice (and its
     block tuning) per shape so BENCH rounds can audit dispatch."""

@@ -29,6 +29,8 @@ def test_profiler_trace_roundtrip():
 
 
 def test_profiler_objects():
+    from mxnet_tpu import telemetry
+    telemetry.reset()
     dom = profiler.Domain("net")
     task = dom.new_task("fwd")
     counter = dom.new_counter("steps", 0)
@@ -40,3 +42,37 @@ def test_profiler_objects():
     profiler.stop()
     assert counter.get_value() == 1
     assert profiler.state() == "stop"
+    # the objects are no longer inert: spans/counters/markers land in
+    # the telemetry journal and snapshot
+    snap = telemetry.snapshot()
+    assert snap["spans"]["profiler.net::fwd"]["count"] == 1
+    assert snap["gauges"]["profiler.net.steps"] == 1
+    assert any(e["kind"] == "marker" and e["name"] == "net::epoch"
+               for e in snap["events"])
+    telemetry.reset()
+
+
+def test_profiler_pause_resume_no_double_start():
+    """pause keeps the logical 'run' state, and set_state('run') on a
+    paused capture RESUMES it (same dir) instead of double-starting a
+    fresh trace."""
+    with tempfile.TemporaryDirectory() as d:
+        profiler.set_config(profile_dir=d)
+        profiler.set_state("run")
+        assert profiler.state() == "run"
+        profiler.pause()
+        assert profiler.state() == "run"       # paused, still logically running
+        assert profiler._STATE["paused"]
+        profiler.set_state("run")              # must resume, not restart
+        assert not profiler._STATE["paused"]
+        assert profiler._STATE["dir"] == d
+        profiler.pause()
+        profiler.resume()
+        assert not profiler._STATE["paused"]
+        profiler.set_state("stop")
+        assert profiler.state() == "stop"
+        # stopping while paused must not call stop_trace twice
+        profiler.set_state("run")
+        profiler.pause()
+        profiler.set_state("stop")
+        assert profiler.state() == "stop" and not profiler._STATE["paused"]

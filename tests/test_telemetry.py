@@ -1,0 +1,374 @@
+"""The always-on telemetry layer (ISSUE 3): spans/counters/gauges,
+recompile detection with cache-key diffs, prefetch/memory gauges in a
+real Trainer run, step-hook-driven Monitor/Speedometer, exporters."""
+import json
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import loss as gloss
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Telemetry state is process-global: every test starts from a
+    clean slate and leaves no step hooks behind."""
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    with telemetry._lock:
+        telemetry._step_hooks.clear()
+    telemetry.set_jsonl_sink(None)
+    telemetry.reset()
+
+
+def _make_net(in_dim=6, classes=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(classes))
+    net.initialize()
+    net(mx.nd.array(onp.zeros((2, in_dim), "float32")))
+    return net
+
+
+def _float_feed(n_batches=4, bs=4, dim=6):
+    """DevicePrefetchIter over a tiny synthetic float32 DataIter."""
+    from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    rs = onp.random.RandomState(0)
+    batches = [rs.randn(bs, dim).astype("float32")
+               for _ in range(n_batches)]
+    labels = [rs.randint(0, 4, bs).astype("float32")
+              for _ in range(n_batches)]
+
+    class F32Iter(DataIter):
+        def __init__(self):
+            super().__init__(bs)
+            self.i = 0
+
+        @property
+        def provide_data(self):
+            return [DataDesc("data", (bs, dim))]
+
+        @property
+        def provide_label(self):
+            return [DataDesc("softmax_label", (bs,))]
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= len(batches):
+                raise StopIteration
+            b = DataBatch([mx.nd.array(batches[self.i])],
+                          [mx.nd.array(labels[self.i])])
+            self.i += 1
+            return b
+
+    return DevicePrefetchIter(F32Iter(), dtype="float32", depth=2)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def test_span_counter_gauge_event_snapshot():
+    with telemetry.span("unit.work"):
+        pass
+    with telemetry.span("unit.work"):
+        pass
+    telemetry.inc("unit.count", 3)
+    telemetry.inc("unit.count")
+    telemetry.gauge("unit.g", 0.5)
+    telemetry.event("phase", "warmup_done", detail=1)
+    snap = telemetry.snapshot()
+    agg = snap["spans"]["unit.work"]
+    assert agg["count"] == 2
+    assert agg["total_ms"] >= agg["max_ms"] >= agg["min_ms"] >= 0
+    assert snap["counters"]["unit.count"] == 4
+    assert snap["gauges"]["unit.g"] == 0.5
+    kinds = [(e["kind"], e["name"]) for e in snap["events"]]
+    assert ("span", "unit.work") in kinds
+    assert ("phase", "warmup_done") in kinds
+    telemetry.reset()
+    snap = telemetry.snapshot()
+    assert not snap["spans"] and not snap["counters"] and not snap["events"]
+
+
+def test_disabled_is_noop():
+    with telemetry.disabled():
+        assert not telemetry.enabled()
+        with telemetry.span("off.work"):
+            pass
+        telemetry.inc("off.c")
+        telemetry.gauge("off.g", 1)
+        telemetry.event("off", "e")
+        telemetry.record_compile("off.fn", {"shape": [1]})
+    assert telemetry.enabled()
+    snap = telemetry.snapshot()
+    assert "off.work" not in snap["spans"]
+    assert "off.c" not in snap["counters"]
+    assert not snap["events"] and not snap["compiles"]
+
+
+def test_journal_is_bounded():
+    for i in range(telemetry.JOURNAL_MAXLEN + 50):
+        telemetry.event("tick", "t%d" % i)
+    snap = telemetry.snapshot(events=0)
+    with telemetry._lock:
+        assert len(telemetry._journal) == telemetry.JOURNAL_MAXLEN
+
+
+# ---------------------------------------------------------------------------
+# recompile detector
+# ---------------------------------------------------------------------------
+
+def test_forced_retrace_names_changed_axis():
+    """The acceptance shape: the SAME jitted step called with a changed
+    batch axis must journal a recompile event naming that axis."""
+    net = _make_net()
+    step = mx.parallel.DataParallelStep(
+        net, gloss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.SGD(learning_rate=0.1), mesh=None)
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.randn(4, 6).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 4, 4).astype("float32"))
+    step(x, y)
+    # same step, changed leading (batch) axis -> forced retrace
+    x2 = mx.nd.array(rs.randn(8, 6).astype("float32"))
+    y2 = mx.nd.array(rs.randint(0, 4, 8).astype("float32"))
+    step(x2, y2)
+    snap = telemetry.snapshot()
+    # detector keys are per-instance (DataParallelStep[<id>]) so
+    # unrelated steps' first compiles never read as retraces
+    counts = [v for k, v in snap["compiles"].items()
+              if k.startswith("DataParallelStep[")]
+    assert counts == [2], snap["compiles"]
+    rec = [e for e in snap["events"] if e["kind"] == "recompile"
+           and e["name"].startswith("DataParallelStep[")]
+    assert len(rec) == 1
+    changed = rec[0]["changed"]
+    assert any("data.shape[0]: 4 -> 8" in c for c in changed), changed
+    # per-step spans recorded for both calls
+    assert snap["spans"]["parallel.step"]["count"] == 2
+
+
+def test_retrace_warning_fires(caplog):
+    telemetry.record_compile("fn", {"shape": [2, 2]})
+    telemetry.record_compile("fn", {"shape": [2, 3]})
+    with caplog.at_level(logging.WARNING):
+        changed = telemetry.record_compile("fn", {"shape": [2, 4]})
+    assert changed == ["shape[1]: 3 -> 4"]
+    assert any("compiled 3 times" in r.message and "shape[1]" in r.message
+               for r in caplog.records)
+
+
+def test_diff_keys_dtype_and_static_args():
+    old = {"data": {"shape": [4, 6], "dtype": "float32"}, "mode": "call"}
+    new = {"data": {"shape": [4, 6], "dtype": "bfloat16"}, "mode": "scan"}
+    d = telemetry._diff_keys(old, new)
+    assert "data.dtype: 'float32' -> 'bfloat16'" in d
+    assert "mode: 'call' -> 'scan'" in d
+
+
+# ---------------------------------------------------------------------------
+# the acceptance run: 3-step Trainer over a prefetched feed
+# ---------------------------------------------------------------------------
+
+def test_trainer_run_snapshot_has_spans_ring_and_memory():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    feed = _float_feed(n_batches=3)
+    steps = 0
+    for batch in feed:
+        with autograd.record():
+            loss = L(net(batch.data[0]), batch.label[0])
+        loss.backward()
+        trainer.step(batch.data[0].shape[0])
+        steps += 1
+    feed.close()
+    assert steps == 3
+    snap = telemetry.snapshot()
+    # step spans
+    assert snap["spans"]["trainer.step"]["count"] == 3
+    assert snap["spans"]["trainer.step"]["mean_ms"] > 0
+    # prefetch ring gauges + stage timings
+    assert "prefetch.ring_occupancy" in snap["gauges"]
+    assert snap["gauges"]["prefetch.ring_depth"] == 2
+    assert snap["counters"]["prefetch.batches"] == 3
+    assert snap["spans"]["prefetch.host"]["count"] == 3
+    assert snap["spans"]["prefetch.ship"]["count"] == 3
+    # memory gauge sampled at the trainer.step span boundary
+    assert snap["gauges"]["mem.host_rss_bytes"] > 0
+    # the fused update compiled exactly once (no retrace storm)
+    assert [v for k, v in snap["compiles"].items()
+            if k.startswith("FusedUpdate[")] == [1]
+
+
+# ---------------------------------------------------------------------------
+# step hooks: Monitor / Speedometer without loop plumbing
+# ---------------------------------------------------------------------------
+
+def _run_steps(net, trainer, n=2, bs=4):
+    L = gloss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.randn(bs, 6).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 4, bs).astype("float32"))
+    for _ in range(n):
+        with autograd.record():
+            loss = L(net(x), y)
+        loss.backward()
+        trainer.step(bs)
+
+
+def test_monitor_attach_pattern_filtering(caplog):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    mon = mx.monitor.Monitor(interval=1, pattern=".*weight.*",
+                             monitor_all=True).attach(trainer)
+    try:
+        with caplog.at_level(logging.INFO):
+            _run_steps(net, trainer, n=2)
+    finally:
+        mon.detach()
+    logged = [r.message for r in caplog.records if "Batch:" in r.message]
+    assert logged, "attached monitor never fired"
+    assert any("weight" in m and "_grad" in m for m in logged)
+    assert any("weight" in m and "_grad" not in m for m in logged)
+    assert not any("bias" in m for m in logged)
+
+
+def test_monitor_attach_monitor_all_false(caplog):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    mon = mx.monitor.Monitor(interval=1, pattern=".*",
+                             monitor_all=False).attach(trainer)
+    try:
+        with caplog.at_level(logging.INFO):
+            _run_steps(net, trainer, n=1)
+    finally:
+        mon.detach()
+    logged = [r.message for r in caplog.records if "Batch:" in r.message]
+    assert logged
+    assert not any("_grad" in m for m in logged)
+    assert any("bias" in m for m in logged)   # pattern .* includes biases
+
+
+def test_monitor_attach_interval():
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    seen = []
+    mon = mx.monitor.Monitor(interval=2, pattern=".*weight.*")
+    orig = mon._collect_trainer
+    mon._collect_trainer = lambda t, i: seen.append(i) or orig(t, i)
+    mon.attach(trainer)
+    try:
+        _run_steps(net, trainer, n=4)
+    finally:
+        mon.detach()
+    assert seen == [0, 2]   # interval=2: steps 0 and 2 are due
+
+
+def test_speedometer_attach_emits_telemetry_line(caplog):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    spd = mx.callback.Speedometer(batch_size=4, frequent=2).attach()
+    spd.set_epoch(3)     # trainer steps carry no epoch; the loop sets it
+    try:
+        with caplog.at_level(logging.INFO):
+            _run_steps(net, trainer, n=5)
+    finally:
+        spd.detach()
+    lines = [r.getMessage() for r in caplog.records
+             if "samples/sec" in r.getMessage()]
+    assert lines, "speedometer never logged"
+    # telemetry-enriched format: step span time rides on the line
+    assert any("step-ms=" in ln for ln in lines)
+    assert all(ln.startswith("Epoch[3]") for ln in lines)
+
+
+def test_step_hook_failure_does_not_break_training(caplog):
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+
+    def bad_hook(rec):
+        raise RuntimeError("observer bug")
+    telemetry.add_step_hook(bad_hook)
+    try:
+        with caplog.at_level(logging.ERROR):
+            _run_steps(net, trainer, n=1)    # must not raise
+    finally:
+        telemetry.remove_step_hook(bad_hook)
+    assert any("step hook" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_export_jsonl_and_streaming_sink(tmp_path):
+    stream = tmp_path / "stream.jsonl"
+    telemetry.set_jsonl_sink(str(stream))
+    with telemetry.span("exp.step"):
+        pass
+    telemetry.inc("exp.count", 2)
+    telemetry.record_compile("exp.fn", {"shape": [4]})
+    telemetry.record_compile("exp.fn", {"shape": [8]})
+    telemetry.set_jsonl_sink(None)
+    streamed = [json.loads(ln) for ln in
+                stream.read_text().strip().splitlines()]
+    assert any(r["kind"] == "span" and r["name"] == "exp.step"
+               for r in streamed)
+    assert any(r["kind"] == "recompile" for r in streamed)
+
+    dump = tmp_path / "dump.jsonl"
+    telemetry.export_jsonl(str(dump))
+    recs = [json.loads(ln) for ln in
+            dump.read_text().strip().splitlines()]
+    snap_rec = [r for r in recs if r["kind"] == "snapshot"]
+    assert len(snap_rec) == 1
+    assert snap_rec[0]["counters"]["exp.count"] == 2
+    assert snap_rec[0]["spans"]["exp.step"]["count"] == 1
+
+
+def test_export_chrome_trace(tmp_path):
+    with telemetry.span("ct.step"):
+        pass
+    telemetry.inc("ct.count")
+    telemetry.event("marker", "ct.mark")
+    path = tmp_path / "telemetry.trace.json"
+    telemetry.export_chrome_trace(str(path))
+    trace = json.loads(path.read_text())
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert any(e["name"] == "ct.step" for e in xs)
+    assert all("ts" in e and "dur" in e for e in xs)
+    assert any(e["ph"] == "C" and e["name"] == "ct.count" for e in evs)
+    assert any(e["ph"] == "i" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# attention dispatch census
+# ---------------------------------------------------------------------------
+
+def test_attention_dispatch_counted():
+    from mxnet_tpu.ops.pallas_attention import attention_dispatch
+    plan = attention_dispatch(8, 8, 64, "float32", on_tpu=False)
+    assert plan["kernel"] == "dense_fallback"
+    assert telemetry.counter("attention.kernel.dense_fallback") == 1
+    plan = attention_dispatch(2048, 2048, 64, "bfloat16", on_tpu=True)
+    assert telemetry.counter("attention.kernel.%s" % plan["kernel"]) == 1
+    snap = telemetry.snapshot()
+    evs = [e for e in snap["events"] if e["kind"] == "attention_dispatch"]
+    assert evs and evs[-1]["seq_q"] == 2048

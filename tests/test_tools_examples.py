@@ -35,6 +35,69 @@ def test_parse_log():
     assert md.startswith("| epoch |") and "| 1 |" in md
 
 
+def test_parse_log_speedometer_telemetry_roundtrip(caplog):
+    """Round-trip: the telemetry-enriched Speedometer line (step-ms /
+    ring fields) emitted by the REAL callback is parsed back by
+    parse_log into the epoch table."""
+    import logging
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.model import BatchEndParam
+
+    telemetry.reset()
+    with telemetry.span("trainer.step"):
+        pass
+    telemetry.gauge("prefetch.ring_occupancy", 3)
+    telemetry.gauge("prefetch.ring_depth", 4)
+    spd = mx.callback.Speedometer(batch_size=4, frequent=2)
+    with caplog.at_level(logging.INFO):
+        for nbatch in (0, 2):
+            spd(BatchEndParam(epoch=1, nbatch=nbatch))
+    lines = ["INFO:root:" + r.getMessage() for r in caplog.records
+             if "samples/sec" in r.getMessage()]
+    assert lines
+    assert "step-ms=" in lines[0] and "ring=3/4" in lines[0]
+    rows = parse_log.parse(lines)
+    assert rows[1]["speed"] and rows[1]["speed"][0] > 0
+    assert rows[1]["step_ms"] and rows[1]["step_ms"][0] >= 0
+    assert rows[1]["ring"] == [0.75]
+    md = parse_log.render(rows)
+    assert "step-ms" in md and "ring" in md
+    telemetry.reset()
+
+
+def test_parse_log_jsonl_roundtrip(tmp_path):
+    """Round-trip: telemetry JSONL metrics sink -> parse_log --jsonl
+    aggregation (spans, counters, recompile diffs)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    from mxnet_tpu import telemetry
+
+    telemetry.reset()
+    for _ in range(3):
+        with telemetry.span("step"):
+            pass
+    telemetry.inc("io.batches", 7)
+    telemetry.record_compile("step_fn", {"shape": [4, 6]})
+    telemetry.record_compile("step_fn", {"shape": [8, 6]})
+    path = tmp_path / "metrics.jsonl"
+    telemetry.export_jsonl(str(path))
+    telemetry.reset()
+
+    with open(path) as f:
+        agg = parse_log.parse_jsonl(f)
+    assert agg["spans"]["step"]["count"] == 3
+    assert agg["spans"]["step"]["mean_ms"] is not None
+    assert agg["counters"]["io.batches"] == 7
+    assert len(agg["recompiles"]) == 1
+    assert agg["recompiles"][0]["changed"] == ["shape[0]: 4 -> 8"]
+    out = parse_log.render_jsonl(agg)
+    assert "| step |" in out and "counter:io.batches" in out
+    assert "shape[0]: 4 -> 8" in out
+
+
 def test_im2rec_roundtrip(tmp_path):
     cv2 = pytest.importorskip("cv2")
     root = tmp_path / "imgs"

@@ -7,9 +7,17 @@ Consumes the log lines the Module/callback stack emits::
     INFO:root:Epoch[3] Time cost=2.3
     INFO:root:Epoch[3] Validation-accuracy=0.94
 
-and prints markdown (or tsv) with one row per epoch.
+the telemetry-enriched Speedometer line::
+
+    INFO:root:Epoch[3] Batch [50-100]\tSpeed: 1234.56 samples/sec\t\
+step-ms=12.345\tring=3/4\taccuracy=0.912000
+
+and (``--jsonl``) the telemetry JSONL metrics sink
+(``mxnet_tpu.telemetry.export_jsonl`` / ``set_jsonl_sink``), and prints
+markdown (or tsv) with one row per epoch.
 """
 import argparse
+import json
 import re
 import sys
 
@@ -17,17 +25,22 @@ TRAIN_RE = re.compile(r"Epoch\[(\d+)\] Train-([\w-]+)=([\d.eE+-]+)")
 VAL_RE = re.compile(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)")
 TIME_RE = re.compile(r"Epoch\[(\d+)\] Time cost=([\d.eE+-]+)")
 SPEED_RE = re.compile(r"Epoch\[(\d+)\].*Speed: ([\d.eE+-]+) samples/sec")
+STEPMS_RE = re.compile(r"Epoch\[(\d+)\].*\bstep-ms=([\d.eE+-]+)")
+RING_RE = re.compile(r"Epoch\[(\d+)\].*\bring=(\d+)/(\d+)")
 
 
 def parse(lines):
     """rows[epoch] = {"train": {metric: v}, "val": {metric: v},
-    "time": float|None, "speed": [..]} — every metric name kept (fit can
-    emit several eval metrics per epoch)."""
+    "time": float|None, "speed": [..], "step_ms": [..], "ring": [..]} —
+    every metric name kept (fit can emit several eval metrics per
+    epoch); step_ms/ring come from the telemetry-enriched Speedometer
+    line."""
     rows = {}
 
     def row(e):
         return rows.setdefault(int(e), {"train": {}, "val": {},
-                                        "time": None, "speed": []})
+                                        "time": None, "speed": [],
+                                        "step_ms": [], "ring": []})
     for line in lines:
         m = TRAIN_RE.search(line)
         if m:
@@ -41,24 +54,112 @@ def parse(lines):
         m = SPEED_RE.search(line)
         if m:
             row(m.group(1))["speed"].append(float(m.group(2)))
+        m = STEPMS_RE.search(line)
+        if m:
+            row(m.group(1))["step_ms"].append(float(m.group(2)))
+        m = RING_RE.search(line)
+        if m:
+            row(m.group(1))["ring"].append(
+                int(m.group(2)) / max(1, int(m.group(3))))
     return rows
+
+
+def parse_jsonl(lines):
+    """Parse a telemetry JSONL sink (one JSON object per line) into
+    ``{"spans": {name: {count, mean_ms, total_ms}}, "counters": {...},
+    "gauges": {...}, "recompiles": [...], "steps": int}``.
+
+    Span stats are aggregated from the per-event ``dur_ms`` stream; a
+    trailing ``kind="snapshot"`` record (written by ``export_jsonl``)
+    overrides counters/gauges with the authoritative final values."""
+    spans = {}
+    counters = {}
+    gauges = {}
+    recompiles = []
+    steps = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        kind = rec.get("kind")
+        if kind == "span":
+            s = spans.setdefault(rec["name"], {"count": 0, "total_ms": 0.0})
+            s["count"] += 1
+            s["total_ms"] += float(rec.get("dur_ms", 0.0))
+        elif kind == "step":
+            steps += 1
+        elif kind == "recompile":
+            recompiles.append({"name": rec.get("name"),
+                               "n": rec.get("n"),
+                               "changed": rec.get("changed", [])})
+        elif kind == "snapshot":
+            counters.update(rec.get("counters", {}))
+            gauges.update(rec.get("gauges", {}))
+            for name, agg in rec.get("spans", {}).items():
+                spans[name] = {"count": agg["count"],
+                               "total_ms": agg["total_ms"]}
+    for s in spans.values():
+        s["mean_ms"] = round(s["total_ms"] / s["count"], 4) \
+            if s["count"] else None
+        s["total_ms"] = round(s["total_ms"], 4)
+    return {"spans": spans, "counters": counters, "gauges": gauges,
+            "recompiles": recompiles, "steps": steps}
+
+
+def render_jsonl(agg, fmt="markdown"):
+    """One row per span name, then counters — the epoch-table analogue
+    for the metrics sink."""
+    header = ["span", "count", "mean-ms", "total-ms"]
+    out = []
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    for name in sorted(agg["spans"]):
+        s = agg["spans"][name]
+        vals = [name, str(s["count"]), "%.6g" % (s["mean_ms"] or 0),
+                "%.6g" % s["total_ms"]]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    for name in sorted(agg["counters"]):
+        vals = ["counter:" + name, "%.6g" % agg["counters"][name], "-", "-"]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    if agg["recompiles"]:
+        out.append("")
+        out.append("recompiles:")
+        for r in agg["recompiles"]:
+            out.append("  %s (#%s): %s" % (r["name"], r["n"],
+                                           "; ".join(r["changed"])))
+    return "\n".join(out)
 
 
 def render(rows, fmt="markdown"):
     train_metrics = sorted({k for r in rows.values() for k in r["train"]})
     val_metrics = sorted({k for r in rows.values() for k in r["val"]})
+    has_step = any(r["step_ms"] for r in rows.values())
+    has_ring = any(r["ring"] for r in rows.values())
     header = (["epoch"] + ["train-%s" % m for m in train_metrics]
-              + ["val-%s" % m for m in val_metrics] + ["time", "speed"])
+              + ["val-%s" % m for m in val_metrics] + ["time", "speed"]
+              + (["step-ms"] if has_step else [])
+              + (["ring"] if has_ring else []))
     out = []
     if fmt == "markdown":
         out.append("| " + " | ".join(header) + " |")
         out.append("| " + " | ".join("---" for _ in header) + " |")
+
+    def mean(xs):
+        return (sum(xs) / len(xs)) if xs else None
     for e in sorted(rows):
         r = rows[e]
-        speed = (sum(r["speed"]) / len(r["speed"])) if r["speed"] else None
         cells = ([r["train"].get(m) for m in train_metrics]
                  + [r["val"].get(m) for m in val_metrics]
-                 + [r["time"], speed])
+                 + [r["time"], mean(r["speed"])]
+                 + ([mean(r["step_ms"])] if has_step else [])
+                 + ([mean(r["ring"])] if has_ring else []))
         vals = [str(e)] + ["%.6g" % v if v is not None else "-"
                            for v in cells]
         if fmt == "markdown":
@@ -73,9 +174,15 @@ def main():
     parser.add_argument("logfile", nargs="?", default="-")
     parser.add_argument("--format", choices=["markdown", "tsv"],
                         default="markdown")
+    parser.add_argument("--jsonl", action="store_true",
+                        help="input is a telemetry JSONL metrics sink, "
+                             "not a text training log")
     args = parser.parse_args()
     lines = sys.stdin if args.logfile == "-" else open(args.logfile)
-    print(render(parse(lines), args.format))
+    if args.jsonl:
+        print(render_jsonl(parse_jsonl(lines), args.format))
+    else:
+        print(render(parse(lines), args.format))
 
 
 if __name__ == "__main__":
