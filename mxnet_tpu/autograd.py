@@ -235,8 +235,12 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         prev = var_acc[k]
         prev_sp = isinstance(prev, _RS) and prev.has_parts
         val_sp = isinstance(value, _RS) and value.has_parts
+        # graftlint: disable-next=trace-tracer-branch -- _RS part flags
+        # are Python bools on the wrapper, not traced values
         if prev_sp and val_sp:
             var_acc[k] = _merge(prev, value)
+        # graftlint: disable-next=trace-tracer-branch -- _RS part flags
+        # are Python bools on the wrapper, not traced values
         elif prev_sp or val_sp:
             # mixed sparse+dense: correctness first — densify
             pd = prev._data if isinstance(prev, NDArray) else prev
@@ -290,11 +294,15 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
             if ag is None or g is None:
                 continue
             from .ndarray.sparse import RowSparseNDArray as _RS
+            # graftlint: disable-next=trace-tracer-branch -- has_parts
+            # is a Python bool on the sparse wrapper, not traced
             if isinstance(g, _RS) and g.has_parts and ag.node is None:
                 # stays sparse through accumulation — leaves only: a
                 # cotangent routed into another recorded node must be a
                 # plain array for that node's jax.vjp
                 gval = g
+            # graftlint: disable-next=trace-tracer-branch -- has_parts
+            # is a Python bool on the sparse wrapper, not traced
             elif isinstance(g, _RS) and g.has_parts:
                 gval = g._data  # non-leaf target: densify
             else:
@@ -315,6 +323,8 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         if ag.grad is None:
             continue
         accum = var_acc[k]
+        # graftlint: disable-next=trace-tracer-branch -- has_parts is a
+        # Python bool on the sparse wrapper, not traced
         if isinstance(accum, _RSW) and accum.has_parts:
             if ag.grad_req == "add":
                 # accumulate-into-buffer requires dense arithmetic

@@ -363,10 +363,16 @@ def _flatten_args(args):
 def _rebuild_args(template, arrays):
     def conv(t):
         tag = t[0]
+        # graftlint: disable-next=trace-tracer-branch -- pytree tags
+        # are Python strings from the flatten template, not traced
         if tag == "__arr__":
             return arrays[t[1]]
+        # graftlint: disable-next=trace-tracer-branch -- pytree tags
+        # are Python strings from the flatten template, not traced
         if tag == "__list__":
             items = [conv(x) for x in t[1]]
+            # graftlint: disable-next=trace-tracer-branch -- t[2] is the
+            # template's Python bool tuple-vs-list marker
             return tuple(items) if t[2] else items
         return t[1]
 
@@ -453,6 +459,9 @@ class _CachedGraph:
                     if p._data._data is not pvals[i]:
                         mutated.append(i)
                         mut_vals.append(p._data._data)
+                # graftlint: disable-next=retrace-closure-array -- meta
+                # is raw_fn's write-through channel reporting trace-time
+                # output metadata; rebuilt once per cache miss
                 meta["n_outputs"] = len(out_vals)
                 meta["mutated"] = mutated
                 meta["out_is_seq"] = is_seq

@@ -155,6 +155,8 @@ class _RNNLayer(HybridBlock):
         if isinstance(states, nd.NDArray):
             states = [states]
         for state, info in zip(states, self.state_info(batch_size)):
+            # graftlint: disable-next=retrace-shape-branch -- state
+            # validation: raises on mismatch, no per-shape code paths
             if state.shape != info["shape"]:
                 raise ValueError(
                     "Invalid recurrent state shape. Expecting %s, got %s." % (

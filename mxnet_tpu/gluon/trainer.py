@@ -123,7 +123,12 @@ class _FusedUpdate:
 
             def fused(wvals, gvals, svals, t, lr_vec):
                 new_w, new_s = [], []
+                # graftlint: disable-next=retrace-closure-array -- step
+                # fns are per-slot constants; fused is jitted once per
+                # (shapes, lr-schedule) cache key by design
                 for k, step in enumerate(steps):
+                    # graftlint: disable-next=retrace-closure-array --
+                    # mp_flags: per-slot Python bools fixed at build
                     if mp_flags[k]:
                         # fp32 master path (reference mp_* kernels):
                         # state leaf 0 is the master; update it in f32

@@ -348,8 +348,12 @@ def merge_row_sparse(a, b):
     """Sum two parts-backed arrays (gradient accumulation): unique union
     of rows, summed values — still ∝ nnz."""
     ai = onp.asarray(a.__dict__["_sp_indices"])
+    # graftlint: disable-next=trace-host-sync -- parts-backed sparse
+    # accumulation is host-resident by design (eager grad path)
     bi = onp.asarray(b.__dict__["_sp_indices"])
     av = onp.asarray(a.__dict__["_sp_values"])
+    # graftlint: disable-next=trace-host-sync -- parts-backed sparse
+    # accumulation is host-resident by design (eager grad path)
     bv = onp.asarray(b.__dict__["_sp_values"])
     uniq, summed = dedup_rows(onp.concatenate([ai, bi]),
                               onp.concatenate([av, bv]))

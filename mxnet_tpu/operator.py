@@ -251,6 +251,9 @@ class _CustomRunner:
                     "programs; run the op eagerly (un-hybridize the "
                     "block, or keep the custom op outside the jitted "
                     "step)" % (op_type,))
+            # graftlint: disable-next=trace-host-callback -- CustomOp's
+            # host fallback by design; gated by _callbacks_supported()
+            # with a clear error on backends without callback support
             return jax.pure_callback(host_forward, out_struct, *ins,
                                      vmap_method="sequential")
 
@@ -262,6 +265,8 @@ class _CustomRunner:
 
         def _vjp_bwd(res, gouts):
             ins, outs = res
+            # graftlint: disable-next=trace-host-callback -- CustomOp's
+            # host fallback by design; gated by _callbacks_supported()
             return tuple(jax.pure_callback(
                 host_backward, in_struct, *gouts, *ins, *outs,
                 vmap_method="sequential"))
@@ -348,6 +353,8 @@ def _callbacks_supported() -> bool:
                     lambda a: onp.asarray(a) + 1,
                     jax.ShapeDtypeStruct((), onp.float32), x))(
                         jnp.zeros((), onp.float32))
+                # graftlint: disable-next=trace-host-sync -- one-shot
+                # capability probe on a concrete array, memoized
                 _CALLBACK_SUPPORT = float(out) == 1.0
         except Exception:
             _CALLBACK_SUPPORT = False

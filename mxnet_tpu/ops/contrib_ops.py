@@ -262,12 +262,18 @@ def boolean_mask(data, index, axis: int = 0):
             "boolean_mask has a data-dependent output shape and cannot run "
             "under jit on TPU; mask with where() or run it eagerly")
     import numpy as onp
+    # graftlint: disable-next=trace-host-sync -- guarded: raises above
+    # when traced; this is the eager host path for data-dependent shape
     keep = onp.asarray(index) != 0
+    # graftlint: disable-next=retrace-shape-branch -- eager-only
+    # validation (op rejects tracers above)
     if keep.shape[0] != data.shape[axis]:
         raise ValueError(
             "boolean_mask: index length %d must equal data.shape[%d]=%d "
             "(the reference rejects this at shape inference)"
             % (keep.shape[0], axis, data.shape[axis]))
+    # graftlint: disable-next=trace-host-sync -- guarded: raises above
+    # when traced; this is the eager host path for data-dependent shape
     return jnp.asarray(onp.compress(keep, onp.asarray(data), axis=axis))
 
 

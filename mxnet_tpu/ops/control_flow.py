@@ -282,6 +282,8 @@ def cond(pred, then_func, else_func):
 
     if not isinstance(pred, NDArray):
         # python-scalar predicate: no tracing needed, run the taken branch
+        # graftlint: disable-next=trace-tracer-branch -- isinstance-
+        # guarded: pred is a Python scalar on this path
         return then_func() if pred else else_func()
     import jax.core as jcore
     if autograd.is_recording() and not isinstance(pred._data, jcore.Tracer):
@@ -289,6 +291,8 @@ def cond(pred, then_func, else_func):
         # the tape (closures differentiate; reference runs the chosen
         # subgraph CachedOp)
         import numpy as onp
+        # graftlint: disable-next=trace-host-sync -- imperative mode
+        # only: the Tracer guard above keeps this off traced paths
         return then_func() if bool(onp.any(pred.asnumpy())) else else_func()
     meta = {}
 

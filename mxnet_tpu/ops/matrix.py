@@ -16,8 +16,14 @@ from .registry import register, alias
 
 @register("dot")
 def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    # graftlint: disable-next=retrace-shape-branch -- rank dispatch is
+    # trace-time specialization by design (one executable per rank)
     a = lhs.T if transpose_a and lhs.ndim == 2 else (jnp.transpose(lhs) if transpose_a else lhs)
+    # graftlint: disable-next=retrace-shape-branch -- rank dispatch is
+    # trace-time specialization by design (one executable per rank)
     b = rhs.T if transpose_b and rhs.ndim == 2 else (jnp.transpose(rhs) if transpose_b else rhs)
+    # graftlint: disable-next=retrace-shape-branch -- rank dispatch is
+    # trace-time specialization by design (one executable per rank)
     if a.ndim == 1 and b.ndim == 1:
         return jnp.dot(a, b)
     # MXNet dot: contract last axis of a with first axis of b (tensordot-1)
@@ -219,6 +225,8 @@ def ones_like(data):
 
 @register("diag")
 def diag(data, k: int = 0, axis1: int = 0, axis2: int = 1):
+    # graftlint: disable-next=retrace-shape-branch -- rank dispatch is
+    # trace-time specialization by design (vector vs matrix diag)
     if data.ndim == 1:
         return jnp.diag(data, k)
     return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)

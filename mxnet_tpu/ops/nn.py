@@ -36,6 +36,8 @@ except ImportError:  # older jax: names unused, identity keeps semantics
 def fully_connected(data, weight, bias=None, num_hidden: int = 0,
                     no_bias: bool = False, flatten: bool = True):
     """Reference src/operator/nn/fully_connected-inl.h: y = x·Wᵀ + b."""
+    # graftlint: disable-next=retrace-shape-branch -- rank dispatch is
+    # trace-time specialization by design (reference FC flatten rule)
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
     y = jnp.matmul(x, weight.T)
     if bias is not None and not no_bias:
@@ -351,6 +353,8 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5,
                output_mean_var: bool = False):
+    # graftlint: disable-next=retrace-shape-branch -- kernel-vs-dense
+    # choice is per-shape trace-time specialization by design
     if axis in (-1, data.ndim - 1) and not output_mean_var \
             and os.environ.get("MXNET_FUSED_LAYERNORM", "") == "1":
         # opt-in fused Pallas kernels (one read + one write fwd, fused
@@ -446,6 +450,8 @@ def leaky_relu(data, gamma=None, act_type: str = "leaky", slope: float = 0.25,
         return jnp.where(data > 0, data, slope * data)
     if act_type == "prelu":
         g = gamma
+        # graftlint: disable-next=retrace-shape-branch -- rank dispatch
+        # is trace-time specialization by design (per-channel broadcast)
         shape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
         return jnp.where(data > 0, data, g.reshape(shape) * data)
     if act_type == "elu":
@@ -566,6 +572,8 @@ def softmax_output(data, label, grad_scale: float = 1.0, ignore_label: float = -
     smoothing (mshadow SoftmaxGrad/SmoothSoftmaxGrad)."""
     knobs = (float(grad_scale), float(ignore_label), bool(use_ignore),
              str(normalization), float(smooth_alpha), int(data.shape[0]))
+    # graftlint: disable-next=retrace-shape-branch -- rank dispatch is
+    # trace-time specialization by design (reference multi-output rule)
     if data.ndim > 2 and multi_output:
         # (N, C, ...) softmax over C with per-position labels
         x = jnp.moveaxis(data, 1, -1)

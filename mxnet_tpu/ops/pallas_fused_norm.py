@@ -186,6 +186,8 @@ def fused_scale_shift_add_relu(x2d, scale, shift, r2d):
 def _fssar_fwd(x2d, scale, shift, r2d):
     s_row = scale.astype(jnp.float32).reshape(1, -1)
     t_row = shift.astype(jnp.float32).reshape(1, -1)
+    # graftlint: disable-next=retrace-shape-branch -- kernel-vs-dense
+    # choice is per-shape trace-time specialization by design
     if not _use_pallas() or _pick_blocks(x2d.shape[0], x2d.shape[1], 5) \
             is None:
         y = _jnp_epilogue(x2d, s_row, t_row, r2d)
@@ -219,6 +221,8 @@ def fused_bn_add_relu_epilogue(data, scale, shift, residual, axis):
     axis and everything minor to it into the lane (column) dimension —
     ``cols = C * trail`` with scale/shift repeated per trailing element —
     so NCHW and NHWC both route to the 2D kernel without a transpose."""
+    # graftlint: disable-next=retrace-shape-branch -- shape validation:
+    # raises on mismatch, no per-shape code paths
     if residual.shape != data.shape:
         raise ValueError("residual shape %r must match data shape %r"
                          % (residual.shape, data.shape))

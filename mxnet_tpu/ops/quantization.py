@@ -114,6 +114,8 @@ def quantized_fully_connected(data, weight, bias, min_data, max_data,
                               flatten: bool = True):
     """int8 x int8 → int32 matmul on the MXU (reference
     quantized_fully_connected.cc).  Returns (int32 out, min, max)."""
+    # graftlint: disable-next=retrace-shape-branch -- rank dispatch is
+    # trace-time specialization by design (reference FC flatten rule)
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
     acc = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
                           preferred_element_type=jnp.int32)

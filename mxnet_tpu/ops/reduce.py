@@ -94,6 +94,8 @@ def broadcast_to(data, shape=()):
 def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
     if lhs_axes is None:
         cur = lhs.shape
+        # graftlint: disable-next=retrace-shape-branch -- rank dispatch
+        # is trace-time specialization by design (broadcast alignment)
         if len(cur) < rhs.ndim:
             cur = (1,) * (rhs.ndim - len(cur)) + tuple(cur)
         return jnp.broadcast_to(lhs.reshape(cur), rhs.shape)

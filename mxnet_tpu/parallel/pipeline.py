@@ -123,6 +123,8 @@ def pipeline_train_step(stage_fns, params, inputs, labels, mesh: Mesh,
     if len(stage_fns) != nstage:
         raise ValueError("need exactly %d stage fns (one per %r slice), "
                          "got %d" % (nstage, axis, len(stage_fns)))
+    # graftlint: disable-next=retrace-shape-branch -- stage-count
+    # validation: raises on mismatch, no per-shape code paths
     if len(params) != nstage:
         raise ValueError("need %d per-stage param trees, got %d"
                          % (nstage, len(params)))
@@ -220,6 +222,9 @@ class PipelineTrainer:
             loss, grads = jax.value_and_grad(loss_of)(leaves)
             new_leaves, new_states = [], []
             for i, (w, g) in enumerate(zip(leaves, grads)):
+                # graftlint: disable-next=retrace-closure-array -- step
+                # fns are per-slot constants; step_fn is jitted once per
+                # trainer build by design
                 res = steps[i](w, g, t, lr.astype(w.dtype), *states[i])
                 # traced-t bias corrections are strong f32; pin the
                 # carry (see optimizer.pin_update_dtypes)

@@ -1,0 +1,7 @@
+"""Seeded-violation fixture modules for tests/test_lint.py.
+
+NEVER imported at test time — graftlint parses them as source.  Each
+seeded violation carries a trailing ``# expect: <rule>`` marker on the
+line the checker must anchor its finding to; the test asserts the exact
+(rule, line) set per file.
+"""

@@ -1,0 +1,60 @@
+"""donation fixture, including a static reconstruction of the PR 3
+use-after-donate class: a donating train-step executable whose donated
+buffers are read again by the caller (on jaxlib<=0.4.36 the persistent-
+cache reload of such a pair computed NaN and segfaulted)."""
+import jax
+import jax.numpy as jnp
+
+
+def _step(params, opt_state, batch):
+    grads = jax.grad(lambda p: jnp.sum(p * batch))(params)
+    return params - grads, opt_state, jnp.sum(grads)
+
+
+def train_loop(params, opt_state, batches):
+    # clean: the donated carries are REBOUND by each call
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    for batch in batches:
+        params, opt_state, loss = step(params, opt_state, batch)
+    return params, loss
+
+
+def pr3_use_after_donate(params, opt_state, batch):
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    new_p, new_s, loss = step(params, opt_state, batch)
+    drift = params - new_p  # expect: donate-use-after-donate
+    return drift, loss
+
+
+def refeed_donated(params, opt_state, b1, b2):
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    new_p, new_s, _ = step(params, opt_state, b1)
+    return step(params, new_s, b2)  # expect: donate-use-after-donate
+
+
+def borrowed_is_safe(params, opt_state, batch):
+    # clean: mark_borrowed() opts the buffer out of donation
+    params.mark_borrowed()
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    new_p, new_s, loss = step(params, opt_state, batch)
+    return params - new_p
+
+
+def _make_updater():
+    def upd(w, g):
+        return w - 0.1 * g
+    return jax.jit(upd, donate_argnums=(0,))
+
+
+def helper_returned_donation(w, g):
+    # the donating callable came from a helper's return statement
+    upd = _make_updater()
+    new_w = upd(w, g)
+    return w + new_w  # expect: donate-use-after-donate
+
+
+def metadata_reads_are_safe(w, g):
+    upd = _make_updater()
+    new_w = upd(w, g)
+    n = len(w) if isinstance(w, list) else 1   # clean: handle metadata
+    return new_w, n

@@ -1,0 +1,41 @@
+"""retrace-hazard fixture: static recompile hazards."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def configured(x, opts=[1, 2]):
+    return x * opts[0]
+
+
+jit_configured = jax.jit(configured, static_argnames=("opts",))  # expect: retrace-unhashable-static
+
+
+def build_kernel(n):
+    table = np.arange(n)
+
+    def kernel(x):
+        return x + table  # expect: retrace-closure-array
+
+    return jax.jit(kernel)
+
+
+@jax.jit
+def padded(x):
+    if x.shape[0] % 8:  # expect: retrace-shape-branch
+        x = jnp.pad(x, (0, 8 - x.shape[0] % 8))
+    return x
+
+
+def sweep(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # expect: retrace-jit-in-loop
+        out.append(f(x))
+    return out
+
+
+def hoisted(xs):
+    # clean: the jit is constructed once, outside the loop
+    f = jax.jit(lambda v: v * 2)
+    return [f(x) for x in xs]

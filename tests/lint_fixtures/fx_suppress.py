@@ -1,0 +1,17 @@
+"""suppression fixture: honored suppressions, mandatory reasons,
+unknown-rule hygiene.  The expect markers list what must survive as NEW
+findings; the test additionally asserts the suppressed set."""
+import jax
+
+
+@jax.jit
+def noisy(x):
+    a = float(x.sum())  # graftlint: disable=trace-host-sync -- fixture: epoch-boundary sync is intended here
+    # graftlint: disable-next=trace-host-sync -- fixture: reason on the
+    # disable-next form, covering the whole statement below
+    b = float(x.min() +
+              x.max())
+    c = float(x.mean())  # graftlint: disable=trace-host-sync  # expect: trace-host-sync, lint-suppression-reason
+    d = float(x.var())  # graftlint: disable=bogus-rule -- some reason  # expect: trace-host-sync, lint-unknown-rule
+    e = float(x.std())  # graftlint: disable=retrace-shape-branch -- wrong rule id  # expect: trace-host-sync
+    return a + b + c + d + e

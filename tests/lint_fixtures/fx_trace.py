@@ -1,0 +1,41 @@
+"""trace-safety fixture: host syncs and tracer branches under jit."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def helper(v):
+    # reachable transitively from the jitted entry below
+    return v.item()  # expect: trace-host-sync
+
+
+@jax.jit
+def entry(x):
+    m = float(x.mean())  # expect: trace-host-sync
+    if x.sum() > 0:  # expect: trace-tracer-branch
+        x = x + m
+    for _ in range(x.shape[0]):      # clean: shape is trace-time Python
+        x = x * 2
+    for _ in range(x.argmax()):  # expect: trace-tracer-branch
+        x = x * 2
+    h = np.asarray(x)  # expect: trace-host-sync
+    jax.debug.print("x={}", x)  # expect: trace-host-callback
+    return helper(x) + h
+
+
+def host_only(y):
+    # NOT jit-reachable: identical syncs must not be flagged
+    if y.sum() > 0:
+        return float(y.mean())
+    return np.asarray(y)
+
+
+class HybridBlock:
+    pass
+
+
+class Head(HybridBlock):
+    def hybrid_forward(self, F, x):
+        # Block-like forward methods are trace entries
+        flag = bool(x.max())  # expect: trace-host-sync
+        return x, flag

@@ -1,0 +1,6 @@
+"""Developer tooling for mxnet-tpu.
+
+A real package (not a loose script directory) so the static analyzer is
+invocable as ``python -m tools.lint``; the standalone scripts
+(``im2rec.py``, ``parse_log.py``, …) still run directly.
+"""

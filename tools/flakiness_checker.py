@@ -1,24 +1,46 @@
 #!/usr/bin/env python
-"""Run a test many times to estimate flakiness (reference
-``tools/flakiness_checker.py``): same CLI shape —
-``python tools/flakiness_checker.py test_module.test_name [-n trials]``.
+"""Measure per-test flake rates over repeated runs (reference
+``tools/flakiness_checker.py``, rebuilt around the tier-1 gate).
 
-Each trial runs under a fresh random seed (MXNET_TEST_SEED, honored by
-the suite's seeded fixtures) in a fresh interpreter, so state cannot
-leak between trials.  Exits nonzero if any trial fails.
+Runs the tier-1 selection (``tests/ -m 'not slow'``) — or a single test
+spec — N times, each trial in a fresh interpreter under a fresh random
+seed (``MXNET_TEST_SEED``, honored by the suite's seeded fixtures), and
+aggregates per-test outcomes from the per-trial junit XML into a JSON
+report::
+
+    python tools/flakiness_checker.py -n 5 --json flakes.json
+    python tools/flakiness_checker.py test_module.test_name -n 20
+
+Report shape::
+
+    {"trials": N, "marker": "not slow", "seeds": [...],
+     "tests": {nodeid: {"runs": n, "failures": k, "errors": e,
+                        "skips": s, "flake_rate": k/n}},
+     "flaky": [nodeid...],        # 0 < failures < runs
+     "always_fail": [nodeid...],  # failures == runs
+     "summary": {"tests": T, "flaky": F, "always_fail": A}}
+
+Exit status: 0 = stable, 1 = flaky tests found, 2 = every trial was
+unrunnable.  ``always_fail`` tests are reported but do NOT flip the
+exit code — a deterministic failure is the tier-1 gate's job; this tool
+measures *stability* (the "no worse than seed" claim needs flake rates,
+not pass/fail).
 """
 import argparse
+import json
 import os
 import random
 import subprocess
 import sys
+import tempfile
+import xml.etree.ElementTree as ET
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def spec_to_pytest(spec):
     """'test_module.test_name' or 'path/to/test.py::name' -> pytest id."""
-    if "::" in spec or spec.endswith(".py"):
+    if "::" in spec or spec.endswith(".py") or os.path.sep in spec:
         return spec
     if "." in spec:
         mod, name = spec.rsplit(".", 1)
@@ -27,36 +49,160 @@ def spec_to_pytest(spec):
     return os.path.join("tests", spec + ".py")   # bare module name
 
 
+def parse_junit(path):
+    """junit XML -> {nodeid: "pass"|"fail"|"error"|"skip"}.
+
+    pytest's junit classname is the dotted module path plus (for
+    class-based tests) the test class: ``tests.test_mod.TestFoo``.  The
+    class segment must become a ``::`` component, not part of the file
+    path, so the reported nodeid can be fed straight back to pytest."""
+    out = {}
+    root = ET.parse(path).getroot()
+    for case in root.iter("testcase"):
+        cls = case.get("classname", "")
+        name = case.get("name", "")
+        if cls:
+            parts = cls.split(".")
+            if parts[-1][:1].isupper():         # PEP8 test class
+                modpath, klass = parts[:-1], parts[-1]
+            else:
+                modpath, klass = parts, None
+            nodeid = "/".join(modpath) + ".py" \
+                + ("::" + klass if klass else "") + "::" + name
+        else:
+            nodeid = name
+        status = "pass"
+        for child in case:
+            if child.tag == "failure":
+                status = "fail"
+            elif child.tag == "error":
+                status = "error"
+            elif child.tag == "skipped":
+                status = "skip"
+        out[nodeid] = status
+    return out
+
+
+def run_trial(target, seed, marker, verbose, extra_env=None):
+    """One fresh-interpreter pytest run; returns (rc, {nodeid: status})."""
+    env = dict(os.environ, MXNET_TEST_SEED=str(seed),
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.update(extra_env or {})
+    with tempfile.NamedTemporaryFile(suffix=".xml", delete=False) as f:
+        xml_path = f.name
+    try:
+        cmd = [sys.executable, "-m", "pytest", target, "-q",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider", "-p", "no:randomly",
+               "--junitxml=" + xml_path]
+        if marker:
+            cmd += ["-m", marker]
+        res = subprocess.run(cmd, cwd=REPO, env=env,
+                             capture_output=not verbose)
+        try:
+            return res.returncode, parse_junit(xml_path)
+        except ET.ParseError:
+            return res.returncode, {}
+    finally:
+        try:
+            os.unlink(xml_path)
+        except OSError:
+            pass
+
+
+def aggregate(trial_results):
+    tests = {}
+    for statuses in trial_results:
+        for nodeid, status in statuses.items():
+            t = tests.setdefault(nodeid, {"runs": 0, "failures": 0,
+                                          "errors": 0, "skips": 0})
+            t["runs"] += 1
+            if status == "fail":
+                t["failures"] += 1
+            elif status == "error":
+                t["errors"] += 1
+            elif status == "skip":
+                t["skips"] += 1
+    for t in tests.values():
+        bad = t["failures"] + t["errors"]
+        t["flake_rate"] = round(bad / t["runs"], 4) if t["runs"] else 0.0
+    flaky = sorted(n for n, t in tests.items()
+                   if 0 < t["failures"] + t["errors"] < t["runs"])
+    always = sorted(n for n, t in tests.items()
+                    if t["runs"] and t["failures"] + t["errors"]
+                    == t["runs"])
+    return tests, flaky, always
+
+
 def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument("test", help="test spec: test_module.test_name or a "
-                                 "pytest id (file.py::name)")
-    ap.add_argument("-n", "--num-trials", type=int, default=10)
+    ap = argparse.ArgumentParser(
+        description="per-test flake rates over N fresh-seed runs of the "
+                    "tier-1 selection (or one test spec)")
+    ap.add_argument("test", nargs="?", default=None,
+                    help="test spec (test_module.test_name / pytest id); "
+                         "default: the whole tier-1 selection (tests/)")
+    ap.add_argument("-n", "--num-trials", type=int, default=5)
     ap.add_argument("-s", "--seed", type=int, default=None,
-                    help="fixed base seed (default: random per trial)")
+                    help="fixed base seed (trial i uses seed+i); "
+                         "default: random per trial")
+    ap.add_argument("-m", "--marker", default=None,
+                    help="pytest -m expression (default: 'not slow' in "
+                         "suite mode, none for a single spec)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the JSON report here (default: stdout "
+                         "alongside the progress lines)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
-    target = spec_to_pytest(args.test)
-    failures = 0
+    suite_mode = args.test is None
+    target = "tests" if suite_mode else spec_to_pytest(args.test)
+    marker = args.marker if args.marker is not None \
+        else ("not slow" if suite_mode else None)
+
+    seeds = []
+    trial_results = []
+    unrunnable = 0
     for trial in range(args.num_trials):
-        seed = args.seed if args.seed is not None \
+        seed = (args.seed + trial) if args.seed is not None \
             else random.randint(0, 2 ** 31 - 1)
-        env = dict(os.environ, MXNET_TEST_SEED=str(seed),
-                   PYTHONPATH=REPO + os.pathsep
-                   + os.environ.get("PYTHONPATH", ""))
-        res = subprocess.run(
-            [sys.executable, "-m", "pytest", target, "-x", "-q"],
-            cwd=REPO, env=env, capture_output=not args.verbose)
-        ok = res.returncode == 0
-        failures += 0 if ok else 1
-        print("trial %d/%d seed=%d: %s"
-              % (trial + 1, args.num_trials, seed,
-                 "PASS" if ok else "FAIL"), flush=True)
-        if not ok and not args.verbose and res.stdout:
-            sys.stdout.write(res.stdout.decode()[-1500:])
-    print("flakiness: %d/%d trials failed" % (failures, args.num_trials))
-    return 1 if failures else 0
+        seeds.append(seed)
+        rc, statuses = run_trial(target, seed, marker, args.verbose)
+        if not statuses:
+            unrunnable += 1
+        trial_results.append(statuses)
+        bad = sum(1 for s in statuses.values() if s in ("fail", "error"))
+        print("trial %d/%d seed=%d: %d tests, %d failing (pytest rc=%d)"
+              % (trial + 1, args.num_trials, seed, len(statuses), bad,
+                 rc), flush=True)
+
+    tests, flaky, always = aggregate(trial_results)
+    report = {
+        "trials": args.num_trials,
+        "target": target,
+        "marker": marker,
+        "seeds": seeds,
+        "tests": tests,
+        "flaky": flaky,
+        "always_fail": always,
+        "summary": {"tests": len(tests), "flaky": len(flaky),
+                    "always_fail": len(always)},
+    }
+    text = json.dumps(report, indent=1, sort_keys=True)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+        print("wrote %s" % args.json_out)
+    else:
+        print(text)
+    for n in flaky:
+        print("FLAKY %s: %d/%d failed" % (
+            n, tests[n]["failures"] + tests[n]["errors"],
+            tests[n]["runs"]))
+    if unrunnable == args.num_trials:
+        print("error: no trial produced test results", file=sys.stderr)
+        return 2
+    return 1 if flaky else 0
 
 
 if __name__ == "__main__":

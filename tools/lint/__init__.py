@@ -1,0 +1,46 @@
+"""graftlint — framework-aware static analysis for the mxnet-tpu JAX
+training stack.
+
+Four checkers (see docs/LINTING.md for the rule catalog):
+
+* trace-safety  — host-sync escapes inside jit-reachable code
+* retrace       — static recompile hazards (the compile-time complement
+                  of telemetry's record_compile detector)
+* donation      — use-after-donate dataflow over donate_argnums users
+* pallas        — BlockSpec/grid/index-map consistency + static VMEM
+                  footprint vs. the tune_attention_blocks clamp budget
+
+Run ``python -m tools.lint mxnet_tpu/`` (text or ``--format json``).
+Findings are suppressed inline with a mandatory reason::
+
+    x = float(v)  # graftlint: disable=trace-host-sync -- epoch boundary
+
+or grandfathered in ``tools/lint/baseline.json``; the tier-1 gate
+(``tests/test_lint.py``) fails on any new unsuppressed finding.
+"""
+from __future__ import annotations
+
+from . import donation, pallas, retrace, trace_safety
+from .core import (Finding, LintResult, ModuleInfo, default_baseline_path,
+                   diff_baseline, load_baseline, run_lint, write_baseline)
+
+__all__ = ["CHECKERS", "all_rules", "run_lint", "Finding", "LintResult",
+           "ModuleInfo", "load_baseline", "write_baseline",
+           "diff_baseline", "default_baseline_path"]
+
+CHECKERS = (trace_safety, retrace, donation, pallas)
+
+# rules owned by the runner itself (suppression hygiene)
+_META_RULES = {
+    "lint-suppression-reason":
+        "graftlint suppression without a '-- <reason>' clause",
+    "lint-unknown-rule": "suppression names an unknown rule id",
+    "lint-parse-error": "file could not be parsed/read",
+}
+
+
+def all_rules() -> dict:
+    rules = dict(_META_RULES)
+    for c in CHECKERS:
+        rules.update(c.RULES)
+    return rules

@@ -1,0 +1,86 @@
+"""CLI: ``python -m tools.lint [paths] [--format json] [...]``.
+
+Exit codes: 0 = clean (no new findings), 1 = new findings, 2 = usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import all_rules
+from .core import default_baseline_path, run_lint, write_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: framework-aware static analysis "
+                    "(trace-safety, retrace, donation, Pallas)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: mxnet_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: tools/lint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings as new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule-id allowlist")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print grandfathered findings")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="emit lint.findings into the telemetry journal")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(all_rules().items()):
+            print("%-28s %s" % (rid, desc))
+        return 0
+
+    paths = args.paths or ["mxnet_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print("error: no such path: %s" % p, file=sys.stderr)
+            return 2
+
+    baseline = None if (args.no_baseline or args.write_baseline) \
+        else (args.baseline or default_baseline_path())
+    if baseline is not None and not os.path.exists(baseline):
+        baseline = None
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+
+    result = run_lint(paths, baseline_path=baseline, rules=rules,
+                      emit_telemetry=args.telemetry)
+
+    if args.write_baseline:
+        path = args.baseline or default_baseline_path()
+        data = write_baseline(path, result.new + result.baselined)
+        print("wrote %d baseline entries (%d findings) to %s"
+              % (len(data["entries"]),
+                 len(result.new) + len(result.baselined), path))
+        return 0
+
+    if args.format == "json":
+        json.dump(result.to_dict(), sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in result.new:
+            print(f.render())
+        if args.show_baselined:
+            for f in result.baselined:
+                print("[baselined] " + f.render())
+        print("graftlint: %d file(s): %d new, %d baselined, "
+              "%d suppressed"
+              % (len(result.files), len(result.new),
+                 len(result.baselined), len(result.suppressed)))
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,350 @@
+"""graftlint core: findings, inline suppressions, baseline, runner.
+
+The analyzer is framework-aware (it understands this repo's JAX idioms —
+jit-reachability, donation, Pallas grids) but the machinery here is
+generic: checkers produce :class:`Finding`s, the runner filters them
+through inline suppressions (``# graftlint: disable=<rule> -- reason``)
+and the checked-in baseline (grandfathered findings, matched by
+(file, rule, context) so line drift never churns it), and whatever
+survives is "new" — the tier-1 gate fails on any of it.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+BASELINE_VERSION = 1
+
+# inline suppression grammar (reason is MANDATORY):
+#   x = float(v)  # graftlint: disable=trace-host-sync -- epoch boundary sync
+#   # graftlint: disable-next=donate-use-after-donate -- identity check only
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<next>-next)?="
+    r"(?P<rules>[A-Za-z0-9_,*-]+)"
+    r"(?P<dash>\s*--(?:\s*(?P<reason>\S.*))?)?")
+
+
+@dataclass
+class Finding:
+    """One diagnostic: ``rule`` identifies the check, ``context`` the
+    enclosing function qualname (baseline identity is line-free)."""
+    rule: str
+    path: str            # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    context: str = "<module>"
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.context)
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "context": self.context}
+
+    def render(self) -> str:
+        return "%s:%d:%d: %s [%s] (in %s)" % (
+            self.path, self.line, self.col, self.message, self.rule,
+            self.context)
+
+
+@dataclass
+class Suppression:
+    line: int            # line the suppression APPLIES to
+    rules: Tuple[str, ...]
+    reason: Optional[str]
+    comment_line: int    # line the comment itself is on
+    used: bool = False
+
+
+class ModuleInfo:
+    """Parsed view of one source file shared by all checkers."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = parse_suppressions(source)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def parse_suppressions(source: str) -> List[Suppression]:
+    lines = source.splitlines()
+    out = []
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(",")
+                      if r.strip())
+        reason = m.group("reason")
+        if reason is None and m.group("dash") and m.group("next") \
+                and i < len(lines):
+            # ONLY the disable-next form with an explicit trailing `--`
+            # may continue its reason on the next comment line (79-col
+            # style); a bare reasonless suppression must NOT steal an
+            # unrelated comment as its reason
+            nxt = lines[i].strip()
+            if nxt.startswith("#") and not _SUPPRESS_RE.search(nxt):
+                cand = nxt.lstrip("#").strip()
+                if cand:
+                    reason = cand
+        if m.group("next"):
+            # skip trailing comment/blank lines so a reason may wrap
+            # onto continuation comment lines (79-col style)
+            target = i + 1
+            while target <= len(lines) and (
+                    not lines[target - 1].strip()
+                    or lines[target - 1].lstrip().startswith("#")):
+                target += 1
+        else:
+            target = i
+        out.append(Suppression(line=target, rules=rules,
+                               reason=reason, comment_line=i))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline file -> {(file, rule, context): count}."""
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError("unsupported baseline version %r"
+                         % (data.get("version"),))
+    table: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("entries", []):
+        key = (e["file"], e["rule"], e.get("context", "<module>"))
+        table[key] = table.get(key, 0) + int(e.get("count", 1))
+    return table
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> dict:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in sorted(findings, key=Finding.sort_key):
+        counts[f.baseline_key()] = counts.get(f.baseline_key(), 0) + 1
+    entries = [{"file": k[0], "rule": k[1], "context": k[2], "count": n}
+               for k, n in sorted(counts.items())]
+    data = {"version": BASELINE_VERSION, "entries": entries}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def diff_baseline(findings: Sequence[Finding],
+                  baseline: Dict[Tuple[str, str, str], int]
+                  ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, baselined).  Matching consumes baseline
+    multiplicity so a file that GAINS a second instance of a
+    grandfathered finding still reports the extra one as new."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in sorted(findings, key=Finding.sort_key):
+        k = f.baseline_key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LintResult:
+    files: List[str] = field(default_factory=list)
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return len(self.new) + len(self.baselined) + len(self.suppressed)
+
+    def to_dict(self) -> dict:
+        return {
+            "files_scanned": len(self.files),
+            "counts": {"new": len(self.new),
+                       "baselined": len(self.baselined),
+                       "suppressed": len(self.suppressed),
+                       "total": self.total},
+            "findings": [f.to_dict() for f in
+                         sorted(self.new, key=Finding.sort_key)],
+            "baselined": [f.to_dict() for f in
+                          sorted(self.baselined, key=Finding.sort_key)],
+            "suppressed": [f.to_dict() for f in
+                           sorted(self.suppressed, key=Finding.sort_key)],
+        }
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    out.append(os.path.join(root, n))
+    return out
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def _apply_suppressions(module: ModuleInfo, findings: List[Finding],
+                        known_rules: Dict[str, str]
+                        ) -> Tuple[List[Finding], List[Finding],
+                                   List[Finding]]:
+    """-> (kept, suppressed, meta) where meta are findings about the
+    suppression comments themselves (missing reason / unknown rule).
+
+    A suppression targeting the first line of a multi-line statement
+    covers findings on the statement's continuation lines too.  For
+    compound statements (if/for/while/with/def) the covered span is the
+    HEADER only — a suppression above an `if` must not blanket every
+    same-rule finding inside its body."""
+    spans: Dict[int, int] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.stmt):
+            end = getattr(node, "end_lineno", node.lineno)
+            body = getattr(node, "body", None)
+            if isinstance(body, list) and body and \
+                    isinstance(body[0], ast.stmt):
+                end = min(end, body[0].lineno - 1)
+            spans[node.lineno] = max(spans.get(node.lineno, 0), end)
+    by_line: Dict[int, List[Suppression]] = {}
+    meta: List[Finding] = []
+    for s in module.suppressions:
+        for ln in range(s.line, spans.get(s.line, s.line) + 1):
+            by_line.setdefault(ln, []).append(s)
+        if not s.reason:
+            meta.append(Finding(
+                rule="lint-suppression-reason", path=module.relpath,
+                line=s.comment_line, col=0,
+                message="graftlint suppression must carry a reason: "
+                        "'# graftlint: disable=<rule> -- <why>'"))
+        for r in s.rules:
+            if r != "*" and r not in known_rules:
+                meta.append(Finding(
+                    rule="lint-unknown-rule", path=module.relpath,
+                    line=s.comment_line, col=0,
+                    message="suppression names unknown rule %r" % (r,)))
+    kept, suppressed = [], []
+    for f in findings:
+        hit = None
+        for s in by_line.get(f.line, ()):
+            if s.reason and ("*" in s.rules or f.rule in s.rules):
+                hit = s
+                break
+        if hit is not None:
+            hit.used = True
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed, meta
+
+
+def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None,
+             emit_telemetry: bool = False) -> LintResult:
+    """Run every checker over ``paths``.
+
+    ``baseline_path``: JSON baseline consumed by :func:`diff_baseline`
+    (None disables baselining — everything unsuppressed is "new").
+    ``rules``: optional rule-id allowlist.  ``emit_telemetry``: bump the
+    ``lint.findings`` counter + journal an event via mxnet_tpu.telemetry
+    (best-effort import; used by the tier-1 gate).
+    """
+    from . import CHECKERS, all_rules
+    from .jitgraph import PackageIndex
+
+    known = all_rules()
+    files = collect_files(paths)
+    root = _repo_root()
+    modules: List[ModuleInfo] = []
+    result = LintResult()
+    parse_errors: List[Finding] = []
+    for path in files:
+        rel = os.path.relpath(os.path.abspath(path), root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(ModuleInfo(path, rel, src))
+        except (OSError, SyntaxError) as e:
+            parse_errors.append(Finding(
+                rule="lint-parse-error", path=rel.replace(os.sep, "/"),
+                line=getattr(e, "lineno", 0) or 0, col=0,
+                message="cannot analyze file: %s" % (e,)))
+    result.files = [m.relpath for m in modules]
+
+    index = PackageIndex(modules)
+    # parse errors ride the normal new/baseline pipeline — an
+    # unanalyzable file must FAIL the gate, not scan as clean
+    raw: List[Finding] = list(parse_errors)
+    for module in modules:
+        per_file: List[Finding] = []
+        for checker in CHECKERS:
+            per_file.extend(checker.check(module, index))
+        if rules:
+            per_file = [f for f in per_file if f.rule in rules]
+        kept, suppressed, meta = _apply_suppressions(module, per_file,
+                                                     known)
+        raw.extend(kept)
+        raw.extend(meta)          # meta findings are never suppressible
+        result.suppressed.extend(suppressed)
+
+    baseline = {}
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+    result.new, result.baselined = diff_baseline(raw, baseline)
+    result.new.sort(key=Finding.sort_key)
+    result.baselined.sort(key=Finding.sort_key)
+    result.suppressed.sort(key=Finding.sort_key)
+
+    if emit_telemetry:
+        try:
+            from mxnet_tpu import telemetry
+            telemetry.inc("lint.findings", len(result.new))
+            telemetry.inc("lint.baselined", len(result.baselined))
+            telemetry.inc("lint.suppressed", len(result.suppressed))
+            telemetry.event("lint", "gate", new=len(result.new),
+                            baselined=len(result.baselined),
+                            suppressed=len(result.suppressed),
+                            files=len(result.files))
+        except Exception:
+            pass
+    return result

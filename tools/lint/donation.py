@@ -1,0 +1,221 @@
+"""donation checker: use-after-donate dataflow.
+
+``donate-use-after-donate`` — within one function, a buffer passed to a
+donating call (``jax.jit(..., donate_argnums=...)`` directly, a local
+bound to one, or a helper/method that *returns* one, like
+``DataParallelStep._build``) is read again afterwards without an
+intervening ``mark_borrowed()`` or rebinding.  On TPU the donated buffer
+is freed device-side — a later read returns garbage or segfaults (the
+PR 3 jaxlib<=0.4.36 persistent-cache crash was exactly this class).
+
+The pass is linear in source order (lint granularity): a donation at
+line D taints every ``Load`` of the donated name/attribute at lines
+> D, killed by a ``Store`` to it or by ``x.mark_borrowed()`` anywhere
+before the read.  Identity bookkeeping over donated *shells* (ring
+guards) is legitimate and should be suppressed with a reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleInfo
+from .jitgraph import (PackageIndex, FunctionInfo, call_target_name,
+                       fold_or_none, shallow_walk)
+
+RULES = {
+    "donate-use-after-donate":
+        "buffer read after being passed to a donating call without an "
+        "intervening mark_borrowed()/rebinding",
+}
+
+# ALL = donated positions unknown -> treat every positional arg as donated
+ALL = object()
+
+
+def _expr_key(node: ast.expr) -> Optional[str]:
+    """Dotted key for Name/attribute chains: 'x', 'self._opt_states'."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_key(node.value)
+        return None if base is None else base + "." + node.attr
+    return None
+
+
+def _jit_donation(node: ast.expr) -> Optional[object]:
+    """If ``node`` is ``jax.jit(f, donate_argnums=...)`` return the
+    donated positions (tuple of ints, or ALL when unfoldable); None if
+    not a donating jit."""
+    if not isinstance(node, ast.Call):
+        return None
+    if call_target_name(node) not in ("jit", "pjit"):
+        return None
+    for kw in node.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = fold_or_none(kw.value)
+            if isinstance(v, int):
+                return (v,)
+            if isinstance(v, tuple) and \
+                    all(isinstance(x, int) for x in v):
+                return v if v else None
+            return ALL
+    return None
+
+
+def _returns_donating(fi: FunctionInfo) -> Optional[object]:
+    """Donated positions if ``fi`` returns a donating jit callable."""
+    for stmt in shallow_walk(fi.node):
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            d = _jit_donation(stmt.value)
+            if d is not None:
+                return d
+    return None
+
+
+class _Event:
+    __slots__ = ("key", "line", "end_line", "node")
+
+    def __init__(self, key, line, end_line, node):
+        self.key = key
+        self.line = line
+        self.end_line = end_line
+        self.node = node
+
+
+def _donated_keys(call: ast.Call, positions) -> List[str]:
+    keys: List[str] = []
+    args = call.args
+    if positions is ALL:
+        idxs = range(len(args))
+    else:
+        idxs = [p for p in positions if p < len(args)]
+    for i in idxs:
+        a = args[i]
+        if isinstance(a, (ast.Tuple, ast.List)):
+            for e in a.elts:
+                k = _expr_key(e)
+                if k is not None:
+                    keys.append(k)
+        else:
+            k = _expr_key(a)
+            if k is not None:
+                keys.append(k)
+    return keys
+
+
+def _analyze_function(module, index, fi, findings):
+    # 1) donating callables visible in this function
+    donating: Dict[str, object] = {}        # local name -> positions
+    for stmt in index.shallow_nodes(fi):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        d = _jit_donation(stmt.value)
+        if d is None and isinstance(stmt.value, ast.Call):
+            callee = index.resolve_call(module, fi, stmt.value.func)
+            if callee is not None:
+                d = _returns_donating(callee)
+        if d is not None:
+            for t in stmt.targets:
+                k = _expr_key(t)
+                if k is not None:
+                    donating[k] = d
+
+    # 2) donation events + kills + reads, in source order
+    donations: List[_Event] = []
+    stores: List[Tuple[str, int]] = []
+    borrows: List[Tuple[str, int]] = []
+    reads: List[_Event] = []
+    call_spans: List[Tuple[int, int]] = []
+
+    # reads that only touch Python metadata of the handle — len()/
+    # isinstance()/type()/id() args and `is`/`is not` operands — never
+    # dereference the device buffer
+    exempt: Set[int] = set()
+    for node in index.shallow_nodes(fi):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("len", "isinstance", "type", "id"):
+            for a in node.args:
+                exempt.add(id(a))
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+            exempt.add(id(node.left))
+            for c in node.comparators:
+                exempt.add(id(c))
+
+    for node in index.shallow_nodes(fi):
+        if isinstance(node, ast.Call):
+            positions = None
+            # direct: jax.jit(f, donate_argnums=...)(x, y)
+            inner = _jit_donation(node.func) \
+                if isinstance(node.func, ast.Call) else None
+            if inner is not None:
+                positions = inner
+            else:
+                k = _expr_key(node.func)
+                if k is not None and k in donating:
+                    positions = donating[k]
+            if positions is not None:
+                end = getattr(node, "end_lineno", node.lineno)
+                call_spans.append((node.lineno, end))
+                for key in _donated_keys(node, positions):
+                    donations.append(_Event(key, node.lineno, end, node))
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "mark_borrowed":
+                k = _expr_key(node.func.value)
+                if k is not None:
+                    borrows.append((k, node.lineno))
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            k = _expr_key(node)
+            if k is None:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, ast.Store):
+                stores.append((k, node.lineno))
+            elif isinstance(ctx, ast.Load) and id(node) not in exempt:
+                reads.append(_Event(k, node.lineno,
+                                    getattr(node, "end_lineno",
+                                            node.lineno), node))
+
+    if not donations:
+        return
+
+    reported: Set[Tuple[str, int]] = set()
+    for r in reads:
+        for d in donations:
+            if r.key != d.key and not r.key.startswith(d.key + "."):
+                continue
+            if r.line <= d.end_line:
+                continue
+            # inside a LATER donating call re-passing the same buffer is
+            # still a read (that is the PR 3 re-feed bug) — only the
+            # originating call span is exempt
+            if any(s <= r.line <= e for s, e in call_spans
+                   if (s, e) == (d.line, d.end_line)):
+                continue
+            killed = any(k == d.key and d.line <= ln <= r.line
+                         for k, ln in stores) or \
+                any(k == d.key and ln <= r.line for k, ln in borrows)
+            if killed:
+                continue
+            if (r.key, r.line) in reported:
+                continue
+            reported.add((r.key, r.line))
+            findings.append(Finding(
+                "donate-use-after-donate", module.relpath, r.line,
+                r.node.col_offset,
+                "%r is read after being donated at line %d — the buffer "
+                "may already be freed; copy it, mark_borrowed() it, or "
+                "rebind before reuse" % (r.key, d.line), fi.qualname))
+            break
+
+
+def check(module: ModuleInfo, index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in index.functions_in(module):
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        _analyze_function(module, index, fi, findings)
+    return findings

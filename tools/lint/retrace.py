@@ -1,0 +1,240 @@
+"""retrace-hazard checker: the static complement of telemetry's
+``record_compile`` detector.
+
+* ``retrace-unhashable-static`` — ``static_argnums``/``static_argnames``
+  naming a parameter whose default is a list/dict/set: every call raises
+  (unhashable) or retraces;
+* ``retrace-closure-array`` — a function handed directly to ``jax.jit``
+  that closes over an array built in the enclosing scope (or a mutable
+  list/dict): the value is baked in as a constant, so every rebuild of
+  the closure is a full retrace and the constant bloats the executable;
+* ``retrace-shape-branch`` — Python branching on ``.shape``/``len()`` of
+  a traced value inside jit-reachable code: legal, but every distinct
+  shape compiles a new executable (the telemetry recompile detector sees
+  these at runtime; this flags them at review time);
+* ``retrace-jit-in-loop`` — ``jax.jit``/``pjit`` called inside a Python
+  loop: each iteration builds a fresh callable with an empty compile
+  cache.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Finding, ModuleInfo
+from .jitgraph import (PackageIndex, call_target_name, call_target_parts,
+                       is_tracing_wrapper_call, shallow_walk)
+from .trace_safety import _span_text
+
+RULES = {
+    "retrace-unhashable-static":
+        "static_argnums/static_argnames naming a parameter with an "
+        "unhashable (list/dict/set) default",
+    "retrace-closure-array":
+        "jitted function closes over an enclosing-scope array or mutable "
+        "container (baked-in constant; rebuild = retrace)",
+    "retrace-shape-branch":
+        "Python branch on .shape/len() of a traced value in "
+        "jit-reachable code (one compile per distinct shape)",
+    "retrace-jit-in-loop":
+        "jax.jit/pjit constructed inside a Python loop (fresh compile "
+        "cache every iteration)",
+}
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+_ARRAY_ROOTS = {"np", "onp", "numpy", "jnp", "jax"}
+
+
+def _jit_call_name(node: ast.Call) -> Optional[str]:
+    name = call_target_name(node)
+    return name if name in ("jit", "pjit") else None
+
+
+def _check_unhashable_static(module, index, findings):
+    for cs in index.call_sites:
+        if cs.module is not module:
+            continue
+        if _jit_call_name(cs.node) is None or not cs.node.args:
+            continue
+        # resolve the WRAPPED function (args[0]), not the jit callee
+        fi = index.resolve_call(cs.module, cs.scope, cs.node.args[0])
+        if fi is None:
+            continue
+        params = fi.params()
+        defaults = {}
+        a = fi.node.args
+        if a.defaults:
+            tail = params[len(params) - len(a.defaults):]
+            defaults = {p.arg: d for p, d in zip(tail, a.defaults)}
+        for kw, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                defaults[kw.arg] = d
+        for name in fi.static_params:
+            d = defaults.get(name)
+            if d is not None and isinstance(d, _UNHASHABLE):
+                findings.append(Finding(
+                    "retrace-unhashable-static", module.relpath,
+                    cs.node.lineno, cs.node.col_offset,
+                    "static arg %r of %s defaults to an unhashable %s — "
+                    "jit static args must be hashable" % (
+                        name, fi.name, type(d).__name__.lower()),
+                    cs.scope.qualname if cs.scope else "<module>"))
+
+
+def _enclosing_bindings(fi) -> dict:
+    """Assignments in the ENCLOSING function scope: name -> value node."""
+    out = {}
+    p = fi.parent
+    while p is not None:
+        for stmt in shallow_walk(p.node):
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id not in out:
+                        out[t.id] = stmt.value
+        p = p.parent
+    return out
+
+
+def _is_array_construction(node: ast.expr) -> bool:
+    if isinstance(node, ast.Call):
+        parts = call_target_parts(node)
+        return bool(parts) and parts[0] in _ARRAY_ROOTS
+    return isinstance(node, (ast.List, ast.Dict, ast.ListComp,
+                             ast.DictComp))
+
+
+def _local_names(fi) -> Set[str]:
+    names: Set[str] = set(fi.param_names() + fi.kwonly_names())
+    a = fi.node.args
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for n in shallow_walk(fi.node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            names.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(n.name)
+        elif isinstance(n, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                            ast.DictComp)):
+            for g in n.generators:
+                for t in ast.walk(g.target):
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _check_closure_capture(module, index, findings):
+    for fi in index.functions_in(module):
+        if fi.parent is None or isinstance(fi.node, ast.Lambda):
+            continue
+        reason = fi.entry_reason or ""
+        if not (reason.startswith("wrapped:jit")
+                or reason.startswith("wrapped:pjit")
+                or reason.startswith("decorator:jit")):
+            continue
+        enclosing = _enclosing_bindings(fi)
+        local = _local_names(fi)
+        seen: Set[str] = set()
+        for n in shallow_walk(fi.node):
+            if not (isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)):
+                continue
+            if n.id in local or n.id in seen or n.id not in enclosing:
+                continue
+            seen.add(n.id)
+            bound = enclosing[n.id]
+            if _is_array_construction(bound):
+                kind = ("array" if isinstance(bound, ast.Call)
+                        else "mutable container")
+                findings.append(Finding(
+                    "retrace-closure-array", module.relpath, n.lineno,
+                    n.col_offset,
+                    "jitted %s closes over enclosing-scope %s %r (built "
+                    "at line %d) — baked in as a constant; pass it as an "
+                    "argument instead" % (fi.name, kind, n.id,
+                                          bound.lineno), fi.qualname))
+
+
+def _shape_read_of_tracer(node: ast.expr, taint) -> Optional[str]:
+    """A `.shape`/`.ndim`/`.size`/len() read of a traced value inside
+    ``node`` — returns a description or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and \
+                sub.attr in ("shape", "ndim", "size") and \
+                taint.expr(sub.value):
+            return "%s.%s" % (_name_of(sub.value), sub.attr)
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Name) and \
+                sub.func.id == "len" and sub.args and \
+                taint.expr(sub.args[0]):
+            return "len(%s)" % _name_of(sub.args[0])
+    return None
+
+
+def _name_of(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return "%s.%s" % (_name_of(node.value), node.attr)
+    return "<expr>"
+
+
+def _check_shape_branch(module, index, findings):
+    for fi in index.functions_in(module):
+        if not fi.reachable or isinstance(fi.node, ast.Lambda):
+            continue
+        taint = index.taint(fi)
+        for node in index.shallow_nodes(fi):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            desc = _shape_read_of_tracer(node.test, taint)
+            if desc is not None:
+                findings.append(Finding(
+                    "retrace-shape-branch", module.relpath,
+                    node.lineno, node.col_offset,
+                    "branch on %s in jit-reachable code: each distinct "
+                    "shape triggers a retrace (intended specialization "
+                    "should be suppressed with a reason)" % desc,
+                    fi.qualname))
+
+
+def _check_jit_in_loop(module, index, findings):
+    flagged: Set[int] = set()
+
+    def scan_loop_body(loop, ctx):
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) and id(node) not in flagged \
+                    and _jit_call_name(node) is not None \
+                    and is_tracing_wrapper_call(node):
+                flagged.add(id(node))
+                findings.append(Finding(
+                    "retrace-jit-in-loop", module.relpath, node.lineno,
+                    node.col_offset,
+                    "jax.%s constructed inside a loop: the compiled-"
+                    "function cache is per-callable, so every iteration "
+                    "recompiles — hoist the jit out of the loop"
+                    % call_target_name(node), ctx))
+
+    def visit(node, ctx):
+        for child in ast.iter_child_nodes(node):
+            nctx = ctx
+            fi = index.function_at(child)
+            if fi is not None:
+                nctx = fi.qualname
+            if isinstance(child, (ast.For, ast.While)):
+                scan_loop_body(child, nctx)
+            visit(child, nctx)
+
+    visit(module.tree, "<module>")
+
+
+def check(module: ModuleInfo, index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    _check_unhashable_static(module, index, findings)
+    _check_closure_capture(module, index, findings)
+    _check_shape_branch(module, index, findings)
+    _check_jit_in_loop(module, index, findings)
+    return findings

@@ -1,0 +1,171 @@
+"""trace-safety checker: host-sync escapes inside jit-reachable code.
+
+Inside every jit-reachable function (see ``jitgraph.PackageIndex``) the
+shared taint pass (``tainting.Taint``) marks values derived from tracer
+params; the checker then flags:
+
+* ``trace-host-sync`` — ``float()``/``int()``/``bool()`` over a traced
+  value, ``.item()``/``.tolist()``/``.asnumpy()``/
+  ``.block_until_ready()``/``jax.device_get``, and ``np.*``/``onp.*``
+  calls fed traced arrays: each forces a device->host round-trip (a
+  trace-time error or a silent pipeline stall);
+* ``trace-tracer-branch`` — Python ``if``/``while``/``assert``/ternary
+  over a traced value, or ``for … in range(traced)``: concretization
+  errors under jit (the lax.cond/scan/where rewrite is the fix).
+  Deliberately NOT flagged: iterating Python containers of tracers
+  (``zip``/``enumerate``/list literals — legal trace-time unrolling)
+  and bare ``while stack:`` worklists over Python lists;
+* ``trace-host-callback`` — ``jax.pure_callback``/``io_callback``/
+  ``jax.debug.*`` inside jit-reachable code (this TPU platform does not
+  support host callbacks).
+
+Taint is deliberately shape-blind: ``x.shape``/``x.ndim``/``len(x)``
+are trace-time Python values, so branching on them is NOT a
+trace-safety violation (the retrace checker owns that hazard).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ModuleInfo
+from .jitgraph import (PackageIndex, call_target_name, call_target_parts,
+                       shallow_walk)
+from .tainting import (NUMPY_ROOTS, SYNC_BUILTINS, SYNC_METHODS,
+                       is_iter_adapter)
+
+RULES = {
+    "trace-host-sync":
+        "device->host sync (float/int/bool/.item()/.asnumpy()/np.*/"
+        "block_until_ready) on a traced value inside jit-reachable code",
+    "trace-tracer-branch":
+        "Python control flow (if/while/assert/range) over a traced "
+        "value inside jit-reachable code",
+    "trace-host-callback":
+        "host callback (jax.pure_callback/io_callback/jax.debug) inside "
+        "jit-reachable code",
+}
+
+_CALLBACKS = {"pure_callback", "io_callback", "debug_callback",
+              "host_callback"}
+
+
+def _callback_call(parts) -> bool:
+    if not parts:
+        return False
+    if parts[-1] in _CALLBACKS:
+        return True
+    # jax.debug.print / jax.debug.callback / debug.breakpoint
+    if "debug" in parts[:-1] and parts[-1] in ("print", "callback",
+                                               "breakpoint"):
+        return True
+    return False
+
+
+def _span_text(module: ModuleInfo, node) -> str:
+    try:
+        return ast.get_source_segment(module.source, node) or ""
+    except Exception:
+        return ""
+
+
+def _branch_findings(module, taint, fi, node, findings):
+    ctx = fi.qualname
+    if isinstance(node, (ast.If, ast.While)) and taint.expr(node.test):
+        # bare `while stack:` worklists over Python lists are idiomatic;
+        # only comparisons/arithmetic over traced values concretize
+        if isinstance(node, ast.While) and \
+                isinstance(node.test, (ast.Name, ast.Attribute)):
+            return
+        findings.append(Finding(
+            "trace-tracer-branch", module.relpath, node.lineno,
+            node.col_offset,
+            "Python %s over a traced value %r concretizes under jit — "
+            "use lax.cond/jnp.where" % (
+                "while" if isinstance(node, ast.While) else "if",
+                _span_text(module, node.test)[:60]), ctx))
+    elif isinstance(node, ast.IfExp) and taint.expr(node.test):
+        findings.append(Finding(
+            "trace-tracer-branch", module.relpath, node.lineno,
+            node.col_offset,
+            "conditional expression over a traced value %r — use "
+            "jnp.where/lax.cond" % (_span_text(module,
+                                               node.test)[:60],), ctx))
+    elif isinstance(node, ast.For):
+        it = node.iter
+        if isinstance(it, ast.Call) and \
+                call_target_name(it) == "range" and \
+                any(taint.expr(a) for a in it.args):
+            findings.append(Finding(
+                "trace-tracer-branch", module.relpath, node.lineno,
+                node.col_offset,
+                "for over range(%s) of a traced value — use "
+                "lax.fori_loop/scan" % (
+                    _span_text(module, it.args[-1])[:50],), ctx))
+        elif not is_iter_adapter(it) and not isinstance(
+                it, (ast.Name, ast.Attribute)) and taint.expr(it):
+            findings.append(Finding(
+                "trace-tracer-branch", module.relpath, node.lineno,
+                node.col_offset,
+                "Python for over a traced value %r unrolls per element "
+                "at trace time — use lax.scan/fori_loop"
+                % (_span_text(module, it)[:60],), ctx))
+    elif isinstance(node, ast.Assert) and taint.expr(node.test):
+        findings.append(Finding(
+            "trace-tracer-branch", module.relpath, node.lineno,
+            node.col_offset,
+            "assert over a traced value concretizes under jit — use "
+            "checkify or drop the assert", ctx))
+
+
+def check(module: ModuleInfo, index: PackageIndex):
+    findings = []
+    for fi in index.functions_in(module):
+        if not fi.reachable or isinstance(fi.node, ast.Lambda):
+            continue
+        taint = index.taint(fi)
+        ctx = fi.qualname
+        for node in index.shallow_nodes(fi):
+            _branch_findings(module, taint, fi, node, findings)
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_target_name(node)
+            parts = call_target_parts(node)
+            if name in SYNC_BUILTINS and len(node.args) >= 1 and \
+                    isinstance(node.func, ast.Name) and \
+                    taint.expr(node.args[0]):
+                findings.append(Finding(
+                    "trace-host-sync", module.relpath, node.lineno,
+                    node.col_offset,
+                    "%s() over a traced value forces a device->host "
+                    "sync under jit" % name, ctx))
+            elif name in SYNC_METHODS and \
+                    isinstance(node.func, ast.Attribute) and \
+                    (taint.expr(node.func.value)
+                     or name == "block_until_ready"):
+                findings.append(Finding(
+                    "trace-host-sync", module.relpath, node.lineno,
+                    node.col_offset,
+                    ".%s() inside jit-reachable code forces a "
+                    "device->host sync" % name, ctx))
+            elif name == "device_get":
+                findings.append(Finding(
+                    "trace-host-sync", module.relpath, node.lineno,
+                    node.col_offset,
+                    "jax.device_get inside jit-reachable code forces a "
+                    "device->host sync", ctx))
+            elif parts and parts[0] in NUMPY_ROOTS and (
+                    any(taint.expr(a) for a in node.args)
+                    or any(taint.expr(k.value) for k in node.keywords)):
+                findings.append(Finding(
+                    "trace-host-sync", module.relpath, node.lineno,
+                    node.col_offset,
+                    "%s over a traced value pulls the array to host — "
+                    "use the jnp equivalent" % ".".join(parts), ctx))
+            elif _callback_call(parts):
+                findings.append(Finding(
+                    "trace-host-callback", module.relpath, node.lineno,
+                    node.col_offset,
+                    "%s inside jit-reachable code: host callbacks are "
+                    "unsupported on this TPU platform — use a jax-"
+                    "native formulation" % ".".join(parts), ctx))
+    return findings

@@ -15,6 +15,11 @@ step-ms=12.345\tring=3/4\taccuracy=0.912000
 and (``--jsonl``) the telemetry JSONL metrics sink
 (``mxnet_tpu.telemetry.export_jsonl`` / ``set_jsonl_sink``), and prints
 markdown (or tsv) with one row per epoch.
+
+``--lint`` renders a graftlint JSON findings report
+(``python -m tools.lint --format json``) as a per-rule/per-file table
+plus the individual new findings — the human-readable face of the lint
+gate's machine output.
 """
 import argparse
 import json
@@ -137,6 +142,51 @@ def render_jsonl(agg, fmt="markdown"):
     return "\n".join(out)
 
 
+def parse_lint(text):
+    """Parse a graftlint ``--format json`` report into
+    ``{"counts": {...}, "by_rule": {rule: n}, "by_file": {path: n},
+    "findings": [...]}`` (new findings only; baselined/suppressed are
+    reflected in counts)."""
+    data = json.loads(text)
+    by_rule = {}
+    by_file = {}
+    for f in data.get("findings", []):
+        by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        by_file[f["path"]] = by_file.get(f["path"], 0) + 1
+    return {"counts": data.get("counts", {}), "by_rule": by_rule,
+            "by_file": by_file, "findings": data.get("findings", [])}
+
+
+def render_lint(agg, fmt="markdown"):
+    """Summary table (new/baselined/suppressed + per-rule counts), then
+    one line per new finding."""
+    c = agg["counts"]
+    header = ["rule", "new"]
+    out = []
+    if fmt == "markdown":
+        out.append("lint: %d new, %d baselined, %d suppressed (%d total)"
+                   % (c.get("new", 0), c.get("baselined", 0),
+                      c.get("suppressed", 0), c.get("total", 0)))
+        out.append("")
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    else:
+        out.append("new\t%d" % c.get("new", 0))
+        out.append("baselined\t%d" % c.get("baselined", 0))
+        out.append("suppressed\t%d" % c.get("suppressed", 0))
+    for rule in sorted(agg["by_rule"]):
+        vals = [rule, str(agg["by_rule"][rule])]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    if agg["findings"]:
+        out.append("")
+        for f in agg["findings"]:
+            out.append("%s:%d: %s [%s] (in %s)"
+                       % (f["path"], f["line"], f["message"], f["rule"],
+                          f.get("context", "?")))
+    return "\n".join(out)
+
+
 def render(rows, fmt="markdown"):
     train_metrics = sorted({k for r in rows.values() for k in r["train"]})
     val_metrics = sorted({k for r in rows.values() for k in r["val"]})
@@ -177,9 +227,14 @@ def main():
     parser.add_argument("--jsonl", action="store_true",
                         help="input is a telemetry JSONL metrics sink, "
                              "not a text training log")
+    parser.add_argument("--lint", action="store_true",
+                        help="input is a graftlint --format json report "
+                             "(python -m tools.lint --format json)")
     args = parser.parse_args()
     lines = sys.stdin if args.logfile == "-" else open(args.logfile)
-    if args.jsonl:
+    if args.lint:
+        print(render_lint(parse_lint(lines.read()), args.format))
+    elif args.jsonl:
         print(render_jsonl(parse_jsonl(lines), args.format))
     else:
         print(render(parse(lines), args.format))
