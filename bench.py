@@ -688,6 +688,71 @@ def bench_telemetry_overhead(batch_size=24, seq_len=512, dtype="bfloat16",
                 "parallel.step", {}).get("count", 0) - before}
 
 
+def bench_zero_sharded_update(batch_size=256, hidden=2048, iters=8):
+    """ZeRO-style cross-replica sharded weight update (arxiv
+    2004.13336): replicated vs ``shard_optimizer=True`` legs of the
+    SAME wide-MLP Adam train step over a dp mesh spanning every local
+    device.  Records what the MULTICHIP artifact gates on — per-chip
+    optimizer-state bytes (must drop ~N-fold) and step time (the
+    sharded step trades the redundant full update for a reduce-scatter/
+    all-gather pair, so it must not regress at bs>=256).  Timing is
+    interleaved min-of-calls so both legs see the same host contention.
+    On a single-device mesh the layout degenerates gracefully and the
+    artifact records n_shards=1."""
+    import time
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    n = len(jax.local_devices())
+    mesh = parallel.device_mesh((n,), ("dp",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def leg(shard):
+        onp.random.seed(7)
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden, activation="relu"),
+                nn.Dense(hidden // 2, activation="relu"), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(batch_size, 123).astype("float32"))
+        y = mx.nd.array(
+            onp.random.randint(0, 10, (batch_size,)).astype("float32"))
+        net(x)
+        step = parallel.DataParallelStep(
+            net, lambda o, l: loss_fn(o, l),
+            mx.optimizer.Adam(learning_rate=1e-3), mesh=mesh,
+            shard_optimizer=shard)
+        step(x, y)   # compile + first update
+        return step, (x, y)
+
+    step_rep, b_rep = leg(False)
+    step_sh, b_sh = leg(True)
+    ms_rep = ms_sh = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        step_rep(*b_rep).asnumpy()
+        d = (time.perf_counter() - t0) * 1e3
+        ms_rep = d if ms_rep is None else min(ms_rep, d)
+        t0 = time.perf_counter()
+        step_sh(*b_sh).asnumpy()
+        d = (time.perf_counter() - t0) * 1e3
+        ms_sh = d if ms_sh is None else min(ms_sh, d)
+    bytes_rep = step_rep.optimizer_state_bytes(per_chip=True)
+    bytes_sh = step_sh.optimizer_state_bytes(per_chip=True)
+    return {"bench": "zero_sharded_update", "batch_size": batch_size,
+            "hidden": hidden, "n_shards": n,
+            "optimizer_state_bytes_per_chip_replicated": bytes_rep,
+            "optimizer_state_bytes_per_chip_sharded": bytes_sh,
+            "state_shrink_factor": round(bytes_rep / max(1, bytes_sh), 2),
+            "step_ms_replicated": round(ms_rep, 3),
+            "step_ms_sharded": round(ms_sh, 3),
+            "sharded_step_ok": n <= 1 or ms_sh <= ms_rep * 1.25,
+            "state_bytes_ok": n <= 1 or bytes_sh * (n - 1) < bytes_rep * n}
+
+
 def bench_ssd(batch_size=32, image_size=128, iters=8):
     """SSD detection train step ON-DEVICE (reference example/ssd +
     multibox_target.cu): forward + MultiBoxTarget assignment (pure
@@ -903,6 +968,8 @@ def main():
                                       iters=max(4, args.iters // 3)))
         jobs.append(lambda: bench_telemetry_overhead(
             iters=max(6, args.iters // 2)))
+        jobs.append(lambda: bench_zero_sharded_update(
+            iters=max(4, args.iters // 3)))
         jobs.append(bench_input_pipeline_isolated)
     else:
         # the default run covers every BASELINE.json config (the driver
@@ -956,6 +1023,11 @@ def main():
                                       iters=max(4, it // 3)))
         # always-on telemetry must stay <= 2% on the hot step (hard gate)
         jobs.append(lambda: bench_telemetry_overhead(iters=max(6, it // 2)))
+        # ZeRO sharded-update A/B: per-chip optimizer-state bytes +
+        # step time, replicated vs shard_optimizer=True (dp mesh over
+        # all local devices; n_shards=1 degenerates gracefully)
+        jobs.append(lambda: bench_zero_sharded_update(
+            iters=max(4, it // 3)))
         # input pipeline (rec -> host -> device -> step legs) — in a FRESH
         # subprocess: after ~14 jobs this process's accumulated jax
         # runtime threads strangle the 1-core decode pool (measured 84

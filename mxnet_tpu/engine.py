@@ -74,16 +74,20 @@ _apply_env_engine_type()
 
 # Persistent-cache entries that are UNSAFE to reload on jaxlib <= 0.4.36:
 # the donated-buffer train-step executables (DataParallelStep's step_fn /
-# scan_fn).  A training loop writes TWO entries for the same step (the
-# first call lowers against fresh host arrays, the donation-settled
-# relowering against committed outputs); a later process that
-# deserializes BOTH and chains them through donation computes NaN and
-# then segfaults/aborts inside jaxlib (reproduced deterministically on
-# the CPU backend with the bert_small train step; single-entry reloads
-# are fine, the poisoned state needs the pair).  Until the runtime bug
-# is gone, these entries are purged at enable time — the step recompiles
+# scan_fn, and the Trainer's fused update since it gained the ZeRO
+# sharded path — sharded inputs make donation settle through a second
+# lowering, which creates the poisoned pair; the plain replicated fused
+# program never relowered and was safe).  A training loop writes TWO
+# entries for the same step (the first call lowers against fresh host
+# arrays, the donation-settled relowering against committed outputs); a
+# later process that deserializes BOTH and chains them through donation
+# computes NaN and then segfaults/aborts inside jaxlib (reproduced
+# deterministically on the CPU backend with the bert_small train step
+# and again with the dp-sharded fused update; single-entry reloads are
+# fine, the poisoned state needs the pair).  Until the runtime bug is
+# gone, these entries are purged at enable time — the step recompiles
 # once per process, everything else stays warm.
-_UNSAFE_CACHE_PREFIXES = ("jit_step_fn-", "jit_scan_fn-")
+_UNSAFE_CACHE_PREFIXES = ("jit_step_fn-", "jit_scan_fn-", "jit_fused-")
 
 
 def _purge_unsafe_entries(path):

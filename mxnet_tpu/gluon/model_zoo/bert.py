@@ -94,7 +94,8 @@ def bert_base(**kwargs):
                      num_heads=12, **kwargs)
 
 
-def bert_small(**kwargs):
-    """4 layers, 256 units, 4 heads — CI-sized."""
-    return BERTModel(units=256, hidden_size=1024, num_layers=4,
-                     num_heads=4, **kwargs)
+def bert_small(num_layers=4, units=256, hidden_size=1024, **kwargs):
+    """4 layers, 256 units, 4 heads — CI-sized (layer count and width
+    overridable: compile-bound tests run a 2-layer/128-unit variant)."""
+    return BERTModel(units=units, hidden_size=hidden_size,
+                     num_layers=num_layers, num_heads=4, **kwargs)

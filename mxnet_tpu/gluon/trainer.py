@@ -51,19 +51,118 @@ class _FusedUpdate:
     row-sparse — the caller then runs the eager per-parameter loop.
     """
 
-    def __init__(self, updater, donate_grads=False):
+    def __init__(self, updater, donate_grads=False, shard_optimizer=False):
         self._updater = updater
         self._donate_grads = donate_grads
         self._cache = {}
         self._unavailable = False
+        # ZeRO-style weight-update sharding (arxiv 2004.13336, see
+        # parallel/data_parallel.py for the SPMD-step variant): when the
+        # weights live REPLICATED on a mesh with a dp axis, the
+        # optimizer state migrates into a flat zero-padded dp-sharded
+        # mirror and the fused program updates only the local 1/N shard
+        # of every weight, all-gathering the result.  The updater's own
+        # state objects become stale while the mirror is live —
+        # ``materialize_states()`` gathers them back (Trainer.save_states
+        # does this), ``invalidate_sharded()`` drops the mirror after an
+        # external state load.
+        self._shard_opt = bool(shard_optimizer)
+        self._sharded = {}       # index -> flat dp-sharded state leaves
+        self._shard_mesh = None
+        self._shard_n = 0
+        self._shard_skip_reported = False
 
     def __getstate__(self):
         # the jitted executables are not picklable (and are cheap to
         # rebuild); Trainer state serialization reaches here via
-        # optimizer.param_dict → Parameter._trainer
+        # optimizer.param_dict → Parameter._trainer.  The sharded
+        # mirror cannot travel either (device-committed arrays) — but
+        # the updater's natural-shape states it shadows are STALE while
+        # it is live, so gather it back first or the pickle carries
+        # step-0 moments
+        self.materialize_states()
         state = self.__dict__.copy()
         state["_cache"] = {}
+        state["_sharded"] = {}
+        state["_shard_mesh"] = None
+        state["_shard_n"] = 0
         return state
+
+    # -- ZeRO sharded-state mirror --------------------------------------
+    def _shard_ready(self, weights):
+        """Engage sharding iff every weight is committed replicated to
+        ONE mesh with a ``dp`` axis of size > 1 — the eager global-view
+        training layout (params broadcast via ``parallel.replicate``).
+        Unplaced (single-device) weights keep the replicated update:
+        migrating them implicitly would move the user's training onto
+        the mesh behind their back."""
+        if not self._shard_opt:
+            return False
+        if self._shard_mesh is not None:
+            return True
+        from ..parallel.mesh import get_mesh
+        import jax.sharding as jsh
+        mesh = get_mesh()
+        if mesh is None or "dp" not in mesh.axis_names or \
+                mesh.shape["dp"] <= 1:
+            return False
+        repl = jsh.NamedSharding(mesh, jsh.PartitionSpec())
+        for w in weights:
+            sh = getattr(w._data, "sharding", None)
+            try:
+                if sh is None or not sh.is_equivalent_to(repl, w._data.ndim):
+                    if not self._shard_skip_reported:
+                        # once, not per step: a 10k-step run would
+                        # otherwise evict every other journal event
+                        self._shard_skip_reported = True
+                        telemetry.event("zero", "trainer_shard_skipped",
+                                        reason="weights not "
+                                               "mesh-replicated")
+                    return False
+            except Exception:
+                return False
+        self._shard_mesh = mesh
+        self._shard_n = int(mesh.shape["dp"])
+        return True
+
+    def _shard_sharding(self, replicated=False):
+        import jax.sharding as jsh
+        spec = jsh.PartitionSpec() if replicated else jsh.PartitionSpec("dp")
+        return jsh.NamedSharding(self._shard_mesh, spec)
+
+    def _sharded_leaves(self, i, leaves):
+        """The flat dp-sharded mirror of index ``i``'s state leaves
+        (built from the updater's natural-shape leaves on first use)."""
+        import jax
+        from ..parallel.collectives import flatten_pad
+        got = self._sharded.get(i)
+        if got is not None:
+            return got
+        spec = self._shard_sharding()
+        flat = [jax.device_put(flatten_pad(l._data, self._shard_n), spec)
+                for l in leaves]
+        self._sharded[i] = flat
+        return flat
+
+    def materialize_states(self):
+        """Gather the sharded mirror back into the updater's natural-
+        shape state NDArrays (the ZeRO checkpoint gather) — call before
+        serializing states.  The mirror stays live afterwards."""
+        from ..parallel.collectives import unflatten
+        if not self._sharded:
+            return
+        is_nd = lambda x: isinstance(x, NDArray)  # noqa: E731
+        import jax
+        for i, flat in self._sharded.items():
+            shells, _ = jax.tree_util.tree_flatten(
+                self._updater.states[i], is_leaf=is_nd)
+            with autograd.pause():
+                for shell, fl in zip(shells, flat):
+                    shell._data = unflatten(fl, shell.shape)
+
+    def invalidate_sharded(self):
+        """Drop the mirror (externally loaded states take over)."""
+        self._sharded.clear()
 
     def __call__(self, indices, grads, weights):
         if self._unavailable:
@@ -76,7 +175,15 @@ class _FusedUpdate:
                for g in grads):
             # parts-backed sparse grads must reach the optimizer's lazy
             # row-sparse branch; the fused dense step would densify them
-            # (and decay momentum on every row)
+            # (and decay momentum on every row).  If the sharded mirror
+            # is live, the eager path must not read the stale updater
+            # states — gather the mirror back first and retire it.
+            if self._sharded:
+                self.materialize_states()
+                self.invalidate_sharded()
+                self._shard_opt = False
+                telemetry.event("zero", "trainer_shard_disabled",
+                                reason="parts-backed sparse gradient")
             return False
         states = self._updater.states
         for i, w in zip(indices, weights):
@@ -103,9 +210,11 @@ class _FusedUpdate:
         # weight-dtype tuple in the key covers them
         mp_flags = [optimizer.multi_precision
                     and onp.dtype(w.dtype).itemsize < 4 for w in weights]
+        sharded = self._shard_ready(weights)
         key = (tuple(indices), fingerprint,
                tuple(optimizer._get_wds(list(indices))),
-               tuple((w.shape, str(w.dtype)) for w in weights))
+               tuple((w.shape, str(w.dtype)) for w in weights),
+               self._shard_n if sharded else 0)
         jfn = self._cache.get(key)
         if jfn is None:
             telemetry.record_compile(
@@ -121,12 +230,37 @@ class _FusedUpdate:
                 self._unavailable = True
                 return False
 
+            if sharded:
+                from ..parallel.collectives import zero_sharded_update
+                SHARD = self._shard_sharding()
+                REPL = self._shard_sharding(replicated=True)
+                shard_n = self._shard_n
+                wshapes = [tuple(w.shape) for w in weights]
+
             def fused(wvals, gvals, svals, t, lr_vec):
                 new_w, new_s = [], []
                 # graftlint: disable-next=retrace-closure-array -- step
                 # fns are per-slot constants; fused is jitted once per
                 # (shapes, lr-schedule) cache key by design
                 for k, step in enumerate(steps):
+                    if sharded:
+                        # ZeRO-sharded update (numerics shared with
+                        # DataParallelStep): replicated grad/weight
+                        # slice to the local flat shard for free, the
+                        # update runs on 1/N elements, only the new
+                        # weight all-gathers back (working dtype);
+                        # state leaves arrive and stay dp-sharded
+                        # graftlint: disable-next=retrace-closure-array -- wshapes:
+                        # per-slot shape tuples fixed at build; fused
+                        # is jitted once per cache key
+                        nw, ns = zero_sharded_update(
+                            step, wvals[k], gvals[k], svals[k], t,
+                            lr_vec[k], shape=wshapes[k],
+                            mp=mp_flags[k], axis_size=shard_n,
+                            shard=SHARD, repl=REPL)
+                        new_w.append(nw)
+                        new_s.append(ns)
+                        continue
                     # graftlint: disable-next=retrace-closure-array --
                     # mp_flags: per-slot Python bools fixed at build
                     if mp_flags[k]:
@@ -165,7 +299,15 @@ class _FusedUpdate:
         lrs = optimizer._get_lrs(list(indices))
         wvals = [w._data for w in weights]
         gvals = [g._data for g in grads]
-        svals = [[l._data for l in lv] for lv in leaves_per]
+        if sharded:
+            svals = [self._sharded_leaves(i, lv)
+                     for i, lv in zip(indices, leaves_per)]
+            telemetry.gauge(
+                "trainer.optimizer_state_bytes_per_chip",
+                sum(int(l.nbytes) // self._shard_n
+                    for sv in svals for l in sv))
+        else:
+            svals = [[l._data for l in lv] for lv in leaves_per]
         new_w, new_s = jfn(wvals, gvals, svals,
                            jnp.asarray(optimizer.num_update, jnp.int32),
                            jnp.asarray(lrs, jnp.float32))
@@ -174,9 +316,15 @@ class _FusedUpdate:
         with autograd.pause():
             for w, nv in zip(weights, new_w):
                 w._data = nv
-            for lv, nlv in zip(leaves_per, new_s):
-                for l, nl in zip(lv, nlv):
-                    l._data = nl
+            if sharded:
+                # the updater's natural-shape shells stay stale while
+                # the mirror is live; materialize_states() gathers them
+                for i, nlv in zip(indices, new_s):
+                    self._sharded[i] = nlv
+            else:
+                for lv, nlv in zip(leaves_per, new_s):
+                    for l, nl in zip(lv, nlv):
+                        l._data = nl
         return True
 
 
@@ -202,7 +350,7 @@ class Trainer:
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None,
-                 donate_grads=False):
+                 donate_grads=False, shard_optimizer=False):
         param_list = []
         if isinstance(params, (dict, ParameterDict)):
             for key in sorted(list(params.keys())):
@@ -233,6 +381,7 @@ class Trainer:
         self._update_on_kvstore = None
         self._params_to_init = []
         self._donate_grads = donate_grads
+        self._shard_optimizer = shard_optimizer
         self._kv_fused = None
         self._local_fused = None
         self._step_count = 0
@@ -383,8 +532,9 @@ class Trainer:
             if jax.process_count() > 1:
                 return False
         if self._kv_fused is None or self._kv_fused._updater is not store._updater:
-            self._kv_fused = _FusedUpdate(store._updater,
-                                          donate_grads=self._donate_grads)
+            self._kv_fused = _FusedUpdate(
+                store._updater, donate_grads=self._donate_grads,
+                shard_optimizer=self._shard_optimizer)
         indices, grads, weights = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -431,8 +581,9 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._local_fused is None or \
                 self._local_fused._updater is not self._updaters:
-            self._local_fused = _FusedUpdate(self._updaters,
-                                             donate_grads=self._donate_grads)
+            self._local_fused = _FusedUpdate(
+                self._updaters, donate_grads=self._donate_grads,
+                shard_optimizer=self._shard_optimizer)
         indices, grads, weights = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -447,6 +598,20 @@ class Trainer:
         for i, g, w in zip(indices, grads, weights):
             self._updaters(i, g, w)
 
+    def _sync_sharded_states(self, invalidate=False):
+        """ZeRO mirror maintenance around state (de)serialization: the
+        fused updates keep dp-sharded flat state mirrors that make the
+        updater's natural-shape states stale — gather them back before a
+        save, and drop the mirrors after a load (the loaded states are
+        now the truth)."""
+        for fused in (self._kv_fused, self._local_fused):
+            if fused is None:
+                continue
+            if invalidate:
+                fused.invalidate_sharded()
+            else:
+                fused.materialize_states()
+
     def save_states(self, fname):
         """(reference trainer.py:440)"""
         assert self._optimizer is not None
@@ -454,6 +619,7 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        self._sync_sharded_states()
         if self._update_on_kvstore and self._kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
@@ -466,6 +632,7 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        self._sync_sharded_states(invalidate=True)
         if self._update_on_kvstore and self._kvstore:
             self._kvstore.load_optimizer_states(fname)
             self._optimizer = self._kvstore._updater.optimizer

@@ -263,6 +263,7 @@ class KVStoreTPU(KVStoreLocal):
 
     def __init__(self, type_str="tpu"):
         super().__init__(type_str)
+        _start_liveness_heartbeat()
 
     def _supports_compression(self):
         # reference: only device/dist stores compress (kvstore.py:423)
@@ -371,9 +372,12 @@ class KVStoreTPU(KVStoreLocal):
         """Number of peer processes the coordination service reports as
         NOT live (reference include/mxnet/kvstore.h:353
         ``get_num_dead_node`` over ps-lite's heartbeat tracking; here the
-        jax coordination service's liveness view).  ``node_id`` is
-        accepted for API parity — the coordination service tracks worker
-        processes, not ps-lite's scheduler/server node ids."""
+        jax coordination service's liveness view, or — on jax clients
+        that don't expose ``get_live_nodes`` — the KV heartbeat records
+        every ``KVStoreTPU`` worker publishes; see
+        ``_start_liveness_heartbeat``).  ``node_id`` is accepted for API
+        parity — the coordination service tracks worker processes, not
+        ps-lite's scheduler/server node ids."""
         import jax
         from jax._src import distributed as _dist
 
@@ -381,6 +385,8 @@ class KVStoreTPU(KVStoreLocal):
         if client is None:
             return 0
         ids = list(range(jax.process_count()))
+        if not hasattr(client, "get_live_nodes"):
+            return _heartbeat_dead_count(client, ids, timeout)
         try:
             live = client.get_live_nodes(ids)
         except Exception as e:
@@ -400,6 +406,103 @@ class KVStoreTPU(KVStoreLocal):
 
 
 import functools
+
+
+# ---------------------------------------------------------------------------
+# KV-store heartbeat liveness (fallback for jax clients without
+# ``DistributedRuntimeClient.get_live_nodes``): every multi-process
+# KVStoreTPU worker publishes a wall-clock heartbeat under
+# ``mxtpu/hb/<rank>`` on the coordinator's key-value store; a peer whose
+# record goes stale past the heartbeat window — or that never wrote one —
+# counts as dead.  The same contract ps-lite's PS_HEARTBEAT_TIMEOUT
+# tracking provides (reference docs/faq/env_var.md DMLC heartbeat family).
+# Single-host clocks make staleness exact; across hosts the window is
+# generous enough (default 10 s) that ordinary NTP skew is noise.
+# ---------------------------------------------------------------------------
+
+_HB_KEY = "mxtpu/hb/%d"
+_hb_state = {"thread": None}
+
+
+def _hb_window() -> float:
+    import os
+    return float(os.environ.get("MXNET_TPU_HEARTBEAT_TIMEOUT", "10"))
+
+
+def _start_liveness_heartbeat():
+    """Start this process's heartbeat publisher (idempotent; only on
+    multi-process runs whose coordination client lacks a native liveness
+    view — with ``get_live_nodes`` the service tracks liveness itself)."""
+    import jax
+    if jax.process_count() <= 1 or _hb_state["thread"] is not None:
+        return
+    from jax._src import distributed as _dist
+    client = getattr(_dist.global_state, "client", None)
+    if client is None or hasattr(client, "get_live_nodes"):
+        return
+    import threading
+    import time as _time
+    rank = jax.process_index()
+    interval = max(0.5, _hb_window() / 4.0)
+
+    def beat():
+        # a transient coordinator error (RPC deadline while it serves a
+        # barrier) must NOT kill the publisher — a dead publisher makes
+        # every peer count this LIVE worker as dead.  Only give up
+        # after several consecutive failures (coordinator really gone,
+        # e.g. shutdown).
+        misses = 0
+        while misses < 5:
+            try:
+                try:
+                    client.key_value_set(_HB_KEY % rank,
+                                         repr(_time.time()),
+                                         allow_overwrite=True)
+                except TypeError:
+                    # older signature without allow_overwrite:
+                    # delete+set (delete of a missing key may raise —
+                    # still part of the same attempt)
+                    try:
+                        client.key_value_delete(_HB_KEY % rank)
+                    except Exception:
+                        pass
+                    client.key_value_set(_HB_KEY % rank,
+                                         repr(_time.time()))
+                misses = 0
+            except Exception:
+                misses += 1
+            _time.sleep(interval)
+
+    t = threading.Thread(target=beat, name="mxtpu-heartbeat", daemon=True)
+    t.start()
+    _hb_state["thread"] = t
+
+
+def _heartbeat_dead_count(client, ids, timeout) -> int:
+    """Count peers with missing-or-stale heartbeat records.
+
+    ``timeout`` bounds the WHOLE query (matching the native
+    ``get_live_nodes`` contract), not each peer: the remaining budget is
+    split across the unread peers so a pile of never-started ranks
+    cannot stretch one poll to ``len(ids) * timeout`` seconds."""
+    import time as _time
+    import jax
+    window = max(_hb_window(), 2.0 * float(timeout))
+    me = jax.process_index()
+    deadline = _time.time() + float(timeout)
+    peers = [r for r in ids if r != me]
+    dead = 0
+    for k, r in enumerate(peers):
+        # at least 50 ms per peer so a present key is always readable
+        budget_ms = max(50, int((deadline - _time.time())
+                                / max(1, len(peers) - k) * 1000))
+        try:
+            raw = client.blocking_key_value_get(_HB_KEY % r, budget_ms)
+            if _time.time() - float(raw) > window:
+                dead += 1
+        except Exception:
+            dead += 1    # never wrote a heartbeat inside the budget
+    return dead
 
 
 def _stack_process_contribution(host, sharding, per_proc):

@@ -32,7 +32,9 @@ from .mesh import (  # noqa: F401
     current_mesh, default_mesh, device_mesh, get_mesh, set_mesh,
 )
 from .collectives import (  # noqa: F401
-    allreduce, all_gather, pmean, ppermute, psum, reduce_scatter,
+    allreduce, all_gather, all_gather_unpad, flatten_pad, padded_size,
+    pmean, ppermute, psum, reduce_scatter, reduce_scatter_padded,
+    unflatten,
 )
 from .data_parallel import DataParallelStep  # noqa: F401
 from .ring_attention import (  # noqa: F401
@@ -44,7 +46,9 @@ from .moe import moe_ffn_init, moe_ffn_apply, moe_ffn_ref  # noqa: F401
 __all__ = [
     "Mesh", "NamedSharding", "P",
     "current_mesh", "default_mesh", "device_mesh", "get_mesh", "set_mesh",
-    "allreduce", "all_gather", "pmean", "ppermute", "psum", "reduce_scatter",
+    "allreduce", "all_gather", "all_gather_unpad", "flatten_pad",
+    "padded_size", "pmean", "ppermute", "psum", "reduce_scatter",
+    "reduce_scatter_padded", "unflatten",
     "DataParallelStep", "ring_attention", "ring_attention_sharded",
     "blockwise_attention", "shard_batch", "replicate", "initialize",
     "pipeline_apply",
@@ -54,6 +58,20 @@ __all__ = [
     "moe_ffn_apply",
     "moe_ffn_ref",
 ]
+
+
+def _dist_is_initialized():
+    """``jax.distributed.is_initialized`` across jax versions (the public
+    accessor only exists on newer clients; older ones expose the live
+    coordination client on the private global state)."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None,
@@ -66,7 +84,7 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
     (MXNET_TPU_COORDINATOR_ADDRESS / _NUM_PROCESSES / _PROCESS_ID), the
     role the reference's DMLC_* env played."""
     import os
-    if getattr(jax.distributed, "is_initialized", lambda: False)():
+    if _dist_is_initialized():
         return  # idempotent: mxnet_tpu auto-joins at import when the
                 # launcher env is set (see mxnet_tpu/__init__.py)
     if coordinator_address is None:
@@ -92,13 +110,25 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         # PS_HEARTBEAT_TIMEOUT, docs/faq/env_var.md DMLC heartbeat family)
         kw["heartbeat_timeout_seconds"] = int(
             os.environ["MXNET_TPU_HEARTBEAT_TIMEOUT"])
+    # drop knobs this jax doesn't know (heartbeat_timeout_seconds and
+    # friends moved between releases) — they tune latency, not semantics
+    import inspect
+    params = inspect.signature(jax.distributed.initialize).parameters
+    if not any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in params.values()):
+        kw = {k: v for k, v in kw.items() if k in params}
     if os.environ.get("MXNET_TPU_RECOVERABLE", "") in ("1", "true"):
         # survive peer failure instead of fail-fast: the kvstore's
         # num_dead_node() liveness view stays queryable after a worker
         # dies (reference get_num_dead_node semantics — survivors keep
         # running; fail-fast remains the default, matching round-3's
-        # hard-failure contract)
-        jax.config.update("jax_enable_recoverability", True)
+        # hard-failure contract).  The config option only exists on
+        # newer jax; older clients already keep the coordination
+        # service's live-nodes view queryable without it.
+        try:
+            jax.config.update("jax_enable_recoverability", True)
+        except AttributeError:
+            pass
     jax.distributed.initialize(**kw)
 
 

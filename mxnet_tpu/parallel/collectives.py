@@ -19,7 +19,9 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
-           "allreduce"]
+           "allreduce", "flatten_pad", "unflatten", "padded_size",
+           "reduce_scatter_padded", "all_gather_unpad",
+           "zero_sharded_update"]
 
 
 def _is_traced(x) -> bool:
@@ -61,6 +63,116 @@ def reduce_scatter(x, axis_name: str = "dp", scatter_dimension: int = 0):
     return _rewrap(
         lax.psum_scatter(val, axis_name, scatter_dimension=scatter_dimension,
                          tiled=True), x)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-style flat shard layout (arxiv 2004.13336: weight-update sharding)
+#
+# Cross-replica sharding of the optimizer state divides each leaf evenly
+# across the ``dp`` axis.  Natural weight shapes almost never divide by
+# the axis size (a (1000,) bias on 8 chips), so every sharded leaf lives
+# in a canonical FLAT layout: ``reshape(-1)`` then zero-pad to the next
+# multiple of the axis size.  The same layout math serves the eager
+# global-view path (sharding annotations, GSPMD inserts the collectives)
+# and the explicit shard_map path (``reduce_scatter_padded`` /
+# ``all_gather_unpad`` below).
+# ---------------------------------------------------------------------------
+
+def padded_size(n: int, axis_size: int) -> int:
+    """Smallest multiple of ``axis_size`` >= n (and >= axis_size, so a
+    scalar leaf still gives every replica one element)."""
+    # graftlint: disable-next=trace-host-sync -- n/axis_size are Python
+    # shape arithmetic (array dims and mesh axis sizes), never tracers
+    return max(1, -(-int(n) // int(axis_size))) * int(axis_size)
+
+
+def flatten_pad(x, axis_size: int):
+    """Flatten to 1-D and zero-pad so the length divides ``axis_size``.
+
+    Works on eager arrays and on tracers (inside jit the pad is a fused
+    concat).  Zero padding is numerics-neutral for every update rule in
+    ``optimizer/``: the pad region of the weight/state is zero, gradients
+    there are zero, and ``wd * 0 == 0`` — whatever garbage the update
+    computes in the pad lanes is dropped by ``unflatten``.
+    """
+    val = _unwrap(x)
+    flat = val.reshape(-1)
+    pad = padded_size(flat.shape[0], axis_size) - flat.shape[0]
+    # graftlint: disable-next=trace-tracer-branch -- pad is static shape
+    # arithmetic (tracer .shape is a Python tuple), a trace-time constant
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def unflatten(flat, shape):
+    """Undo ``flatten_pad``: drop the pad lanes, restore ``shape``."""
+    val = _unwrap(flat)
+    n = 1
+    for d in shape:
+        # graftlint: disable-next=trace-host-sync -- shape is a Python
+        # tuple of static dims, never traced
+        n *= int(d)
+    return val[:n].reshape(shape)
+
+
+def reduce_scatter_padded(x, axis_name: str = "dp", axis_size: int = None):
+    """Flat reduce-scatter with uneven-leaf padding (use under
+    shard_map).  Flattens ``x``, zero-pads to a multiple of
+    ``axis_size`` and psum-scatters — each replica gets the fully
+    reduced 1/N slice of the flat leaf.  ``axis_size`` must be the
+    static size of ``axis_name`` (shard_map callers know their mesh;
+    the pad amount must be a trace-time constant)."""
+    if axis_size is None:
+        raise ValueError("reduce_scatter_padded needs the static "
+                         "axis_size (the pad width is shape math)")
+    flat = flatten_pad(x, axis_size)
+    return lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                            tiled=True)
+
+
+def all_gather_unpad(shard, shape, axis_name: str = "dp"):
+    """Inverse of ``reduce_scatter_padded``: gather the flat shards from
+    every replica, drop the padding, restore the natural ``shape``."""
+    val = _unwrap(shard)
+    flat = lax.all_gather(val, axis_name, axis=0, tiled=True)
+    return unflatten(flat, shape)
+
+
+def zero_sharded_update(step_fn, w, g, state_leaves, t, lr, *, shape,
+                        mp, axis_size, shard, repl):
+    """One weight's ZeRO-sharded optimizer update (arxiv 2004.13336),
+    shared by ``DataParallelStep`` and the Trainer's ``_FusedUpdate``
+    so the numerics live in exactly one place.
+
+    The gradient is flattened/padded and CONSTRAINED to the dp-sharded
+    layout ``shard`` — when its producer is the global-batch mean,
+    GSPMD lowers the (all-reduce, slice) pair to a reduce-scatter; a
+    replicated producer makes it a free local slice.  ``step_fn`` then
+    runs on the local 1/N flat shard only, and the updated weight is
+    constrained back to ``repl`` (replicated), which lowers to an
+    all-gather in the WORKING dtype — under ``mp`` the fp32 master
+    (state leaf 0, sharded) is updated and the half-width weight
+    re-quantized from it before the gather.  State leaves arrive and
+    leave dp-sharded.  Returns ``(new_weight, new_state_leaves)``."""
+    import jax
+    from ..optimizer.optimizer import pin_update_dtypes
+    wsc = jax.lax.with_sharding_constraint
+    if mp:
+        g32 = wsc(flatten_pad(g.astype(jnp.float32), axis_size), shard)
+        master, rest = state_leaves[0], state_leaves[1:]
+        res = step_fn(master, g32, t, lr, *rest)
+        new_master, new_rest = pin_update_dtypes(res, master, rest)
+        new_master = wsc(new_master, shard)
+        half = wsc(new_master.astype(w.dtype), repl)
+        return (unflatten(half, shape),
+                [new_master] + [wsc(s, shard) for s in new_rest])
+    gg = wsc(flatten_pad(g, axis_size), shard)
+    wflat = wsc(flatten_pad(w, axis_size), shard)
+    res = step_fn(wflat, gg, t, lr.astype(w.dtype), *state_leaves)
+    new_wflat, new_st = pin_update_dtypes(res, wflat, state_leaves)
+    return (unflatten(wsc(new_wflat, repl), shape),
+            [wsc(s, shard) for s in new_st])
 
 
 def ppermute(x, perm, axis_name: str = "dp"):

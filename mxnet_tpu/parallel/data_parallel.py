@@ -97,15 +97,34 @@ class DataParallelStep:
 
     The net must be initialized (run one eager forward first if it uses
     deferred shapes).
+
+    ``shard_optimizer=True|False|"auto"`` enables the ZeRO-style
+    cross-replica sharded weight update (arxiv 2004.13336): optimizer
+    state and update compute shard over the ``dp`` axis — reduce-scatter
+    grads, update the local 1/N shard, all-gather params — cutting
+    per-chip optimizer-state memory ~N-fold.  See docs/PERF.md.
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, donate=True,
-                 mirror=None, donate_batch=False):
+                 mirror=None, donate_batch=False, shard_optimizer=False):
         self._net = net
         self._loss = loss_fn
         self._opt = optimizer
         self._mesh = mesh if mesh is not None else get_mesh()
         self._donate = donate
+        # shard_optimizer: ZeRO-style cross-replica sharding of the
+        # weight update (arxiv 2004.13336).  Instead of every chip
+        # holding the full optimizer state and redundantly computing the
+        # full update, each state leaf lives in a flat zero-padded layout
+        # sharded over the ``dp`` axis; gradients are reduce-scattered,
+        # the update runs on the local 1/N shard, and the updated
+        # parameters are all-gathered back to replicated — all inside
+        # the one jitted program, so XLA overlaps the collectives with
+        # backprop.  ``False`` (default) keeps today's replicated path
+        # bit-identical; ``"auto"`` turns it on when the mesh has a dp
+        # axis of size > 1; ``True`` forces it (size-1 dp degenerates to
+        # a no-op layout, handy for CPU tests).
+        self._shard_n = self._resolve_shard_optimizer(shard_optimizer)
         # donate_batch additionally donates the data/label buffers: the
         # step is their last reader (a fresh batch arrives every call),
         # so XLA reuses their HBM pages for step outputs instead of
@@ -135,9 +154,16 @@ class DataParallelStep:
         # half weight, the update applies to the master in fp32, and
         # the half weight is re-quantized from it each step — small
         # updates accumulate instead of rounding away.
+        # NOTE: the flattened leaf lists below are NOT covered by the
+        # optimizer's own state treedef — multi-precision slots carry the
+        # fp32 master as an EXTRA leaf 0 prepended after flattening, and
+        # sharded slots store every leaf in the flat padded layout.  Any
+        # state (de)serializer must strip/re-prepend the master and
+        # ``unflatten`` sharded leaves before unflattening the pytree.
         self._opt_states = []
-        self._state_treedefs = []
         self._mp_slots = []
+        self._shard_slots = []   # per-slot: flat-sharded layout in use?
+        self._shard_meta = []    # per-slot: natural (master) shape
         self._mp_written = {}   # slot -> last weight array THIS step wrote
         mp = bool(getattr(optimizer, "multi_precision", False))
         for slot, i in enumerate(self._trainable):
@@ -146,8 +172,16 @@ class DataParallelStep:
             self._mp_slots.append(use_mp)
             if use_mp:
                 wdata = wdata.astype("float32")   # master (state dtype f32)
+            self._shard_meta.append(tuple(wdata.shape))
+            if self._shard_n:
+                leaves = self._create_sharded_state(optimizer, slot, wdata)
+                if leaves is not None:
+                    self._shard_slots.append(True)
+                    self._opt_states.append(leaves)
+                    continue
+            self._shard_slots.append(False)
             st = optimizer.create_state(slot, wdata)
-            leaves, treedef = jax.tree_util.tree_flatten(
+            leaves, _ = jax.tree_util.tree_flatten(
                 st, is_leaf=lambda x: isinstance(x, NDArray))
             if use_mp:
                 leaves = [wdata] + leaves     # master rides as leaf 0
@@ -161,7 +195,8 @@ class DataParallelStep:
             self._opt_states.append(
                 [jax.device_put(l._data, wdev) if wdev is not None
                  else l._data for l in leaves])
-            self._state_treedefs.append(treedef)
+        if self._shard_n:
+            self._report_shard_layout()
         self._t = optimizer.begin_num_update
         self._cache = {}
         # device-resident per-call operands: a tiny host->device transfer
@@ -174,6 +209,110 @@ class DataParallelStep:
         self._t_dev = None
         self._rng_dev = None
         self._rng_epoch = None
+
+    # ------------------------------------------------------------------
+    # ZeRO-style sharded weight update (arxiv 2004.13336)
+    # ------------------------------------------------------------------
+    def _resolve_shard_optimizer(self, knob):
+        """Resolve the ``shard_optimizer`` knob to the dp-axis size the
+        state is sharded over (0 = replicated path, untouched)."""
+        if knob in (False, None, 0, "0", "off"):
+            return 0
+        if knob not in (True, 1, "1", "on", "auto"):
+            raise ValueError("shard_optimizer must be True/False/'auto', "
+                             "got %r" % (knob,))
+        mesh = self._mesh
+        if mesh is None or "dp" not in mesh.axis_names:
+            if knob == "auto":
+                return 0
+            import warnings
+            warnings.warn("shard_optimizer=True needs a mesh with a 'dp' "
+                          "axis; falling back to the replicated update")
+            return 0
+        n = mesh.shape["dp"]
+        if knob == "auto" and n <= 1:
+            return 0     # nothing to shard over; keep the proven path
+        return int(n)
+
+    def _shard_sharding(self, replicated=False):
+        import jax.sharding as jsh
+        spec = jsh.PartitionSpec() if replicated else jsh.PartitionSpec("dp")
+        return jsh.NamedSharding(self._mesh, spec)
+
+    def _shard_put(self, value):
+        """Eagerly place a natural-shape value into the flat padded
+        layout, sharded over dp (the layout every sharded state leaf
+        lives in between steps)."""
+        from .collectives import flatten_pad
+        return jax.device_put(flatten_pad(value, self._shard_n),
+                              self._shard_sharding())
+
+    def _create_sharded_state(self, optimizer, slot, wdata):
+        """Create slot ``slot``'s optimizer state directly in the flat
+        sharded layout via ``create_state_flat`` — state leaves are born
+        as 1/N shards (plus the fp32 master as leaf 0 under
+        multi-precision), so the full replicated leaf never
+        materializes.  Returns None when the state is not elementwise
+        (a leaf that is not weight-shaped), in which case the slot
+        falls back to the replicated layout."""
+        from ..ndarray.ndarray import _wrap
+        wflat = self._shard_put(wdata._data if isinstance(wdata, NDArray)
+                                else wdata)
+        st = optimizer.create_state_flat(slot, _wrap(wflat))
+        leaves, _ = jax.tree_util.tree_flatten(
+            st, is_leaf=lambda x: isinstance(x, NDArray))
+        vals = []
+        for l in leaves:
+            v = l._data if isinstance(l, NDArray) else jnp.asarray(l)
+            if tuple(v.shape) != tuple(wflat.shape):
+                return None    # structured state: keep slot replicated
+            vals.append(jax.device_put(v, self._shard_sharding()))
+        if self._mp_slots[slot]:
+            vals = [wflat] + vals    # master rides as leaf 0, sharded too
+        return vals
+
+    def optimizer_state_bytes(self, per_chip=True):
+        """Logical optimizer-state footprint in bytes.  With
+        ``per_chip=True`` this is what ONE replica holds: sharded leaves
+        count padded_size/N, replicated leaves count full — the number
+        the ZeRO sharding shrinks N-fold."""
+        total = 0
+        for slot, leaves in enumerate(self._opt_states):
+            for l in leaves:
+                n = int(l.nbytes)
+                if per_chip and self._shard_slots[slot]:
+                    n //= self._shard_n
+                total += n
+        return total
+
+    def _report_shard_layout(self):
+        """Gauge the per-chip state footprint and journal the collective
+        schedule the sharded update compiles to (the collectives run
+        inside XLA, so the journal records the schedule, not per-step
+        host timings)."""
+        per_chip = self.optimizer_state_bytes(per_chip=True)
+        total = self.optimizer_state_bytes(per_chip=False)
+        telemetry.gauge("parallel.optimizer_state_bytes_per_chip",
+                        per_chip)
+        telemetry.gauge("parallel.optimizer_state_bytes_total", total)
+        rs_bytes = ag_bytes = 0
+        for slot, i in enumerate(self._trainable):
+            if not self._shard_slots[slot]:
+                continue
+            w = self._params[i].data()
+            nelem = 1
+            for d in self._shard_meta[slot]:
+                nelem *= int(d)
+            itemsize = onp.dtype(w.dtype).itemsize
+            rs_bytes += (4 if self._mp_slots[slot] else itemsize) * nelem
+            ag_bytes += itemsize * nelem
+        telemetry.event(
+            "zero", "shard_optimizer", axis="dp", n_shards=self._shard_n,
+            sharded_slots=sum(self._shard_slots),
+            replicated_slots=len(self._shard_slots)
+            - sum(self._shard_slots),
+            state_bytes_per_chip=per_chip, state_bytes_total=total,
+            reduce_scatter_bytes=rs_bytes, all_gather_bytes=ag_bytes)
 
     # ------------------------------------------------------------------
     def __call__(self, data, label):
@@ -305,6 +444,23 @@ class DataParallelStep:
             self._rng_dev = _random.next_key()
             self._rng_epoch = _random.seed_epoch()
         pvals = [p._data._data for p in self._params]
+        if self._shard_n:
+            # the sharded program mixes dp-sharded state with the params
+            # in ONE jit call, so every param must be committed to the
+            # mesh (replicated).  Identity is preserved for already-
+            # placed arrays — the step's own outputs — so this only
+            # copies on the first call and after an external set_data
+            # (where the master-resync below must fire anyway).
+            repl = self._shard_sharding(replicated=True)
+            def _onmesh(v):
+                sh = getattr(v, "sharding", None)
+                try:
+                    if sh is not None and sh.is_equivalent_to(repl, v.ndim):
+                        return v
+                except Exception:
+                    pass
+                return jax.device_put(v, repl)
+            pvals = [_onmesh(v) for v in pvals]
         # multi-precision master resync: the fp32 master (state leaf 0)
         # is the source of truth for the update, so an externally
         # mutated weight (load_parameters / set_data after construction)
@@ -313,8 +469,11 @@ class DataParallelStep:
         for slot, i in enumerate(self._trainable):
             if self._mp_slots[slot] and \
                     self._mp_written.get(slot) is not pvals[i]:
-                self._opt_states[slot][0] = jnp.asarray(pvals[i],
-                                                        jnp.float32)
+                master = jnp.asarray(pvals[i], jnp.float32)
+                if self._shard_slots[slot]:
+                    # sharded masters live flat-padded over dp
+                    master = self._shard_put(master)
+                self._opt_states[slot][0] = master
         new_pvals, new_states, self._t_dev, self._rng_dev, loss = jfn(
             pvals, self._opt_states, self._t_dev, self._lrs_dev,
             self._rng_dev, dval, lval)
@@ -350,16 +509,30 @@ class DataParallelStep:
         net, loss_fn, optimizer = self._net, self._loss, self._opt
         params = self._params
         trainable = self._trainable
-        # NOTE: self._state_treedefs describes the optimizer-created
-        # state pytree ONLY — multi-precision slots carry the fp32
-        # master as an EXTRA leaf 0 prepended after flattening, which
-        # the stored treedef does not cover.  Any state (de)serializer
-        # must strip/re-prepend that leaf for slots where
-        # self._mp_slots[slot] is True before unflattening.
         mp_slots = self._mp_slots
-        n = len(params)
+        shard_slots = self._shard_slots
+        shard_meta = self._shard_meta
+        shard_n = self._shard_n
+        if shard_n:
+            from .collectives import zero_sharded_update
+            SHARD = self._shard_sharding()
+            REPL = self._shard_sharding(replicated=True)
         trainset = set(trainable)
         steps = [optimizer.make_step(slot) for slot, _ in enumerate(trainable)]
+
+        def sharded_update(slot, i, w, g, t, lrs, st_leaves):
+            """ZeRO-style update of one slot (arxiv 2004.13336): the
+            gradient's producer is the global-batch mean, so its shard
+            constraint lowers to a reduce-scatter; the optimizer math
+            runs on the local 1/N shard and the updated weight all-
+            gathers back in the working dtype.  State leaves stay
+            sharded across steps — 1/N of the replicated footprint per
+            chip.  The numerics live in collectives.zero_sharded_update
+            (shared with the Trainer's fused path)."""
+            return zero_sharded_update(
+                steps[slot], w, g, st_leaves, t, lrs[slot],
+                shape=shard_meta[slot], mp=mp_slots[slot],
+                axis_size=shard_n, shard=SHARD, repl=REPL)
 
         def run_forward(pvals, rng, dval, lval):
             """Swap traced values into the blocks' parameters, run the
@@ -418,6 +591,13 @@ class DataParallelStep:
             new_states = []
             for slot, (i, g) in enumerate(zip(trainable, grads)):
                 st_leaves = opt_states[slot]
+                if shard_slots[slot]:
+                    # graftlint: disable-next=retrace-closure-array --
+                    # shard flags are per-slot constants fixed at build
+                    new_pvals[i], new_st = sharded_update(
+                        slot, i, pvals[i], g, t, lrs, st_leaves)
+                    new_states.append(new_st)
+                    continue
                 if mp_slots[slot]:
                     # fp32 master path (reference mp_* kernels): update
                     # the master, re-quantize the working weight from it

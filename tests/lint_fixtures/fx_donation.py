@@ -58,3 +58,31 @@ def metadata_reads_are_safe(w, g):
     new_w = upd(w, g)
     n = len(w) if isinstance(w, list) else 1   # clean: handle metadata
     return new_w, n
+
+
+# -- ZeRO sharded-update shapes: donated carries living in container --
+# -- entries (per-slot sharded state leaves), tracked by subscript key --
+
+def sharded_carry_use_after_donate(sharded, i, grads):
+    """The reduce-scatter update donates one slot's sharded state
+    leaves; reading that slot again without rebinding is a read of a
+    freed shard."""
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    new_w, new_s, loss = step(grads, sharded[i], grads)
+    stale = sharded[i]  # expect: donate-use-after-donate
+    return new_s, stale, loss
+
+
+def sharded_carry_rebound_is_clean(sharded, i, grads):
+    # clean: the slot entry is REBOUND to the program's output leaves
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    new_w, new_s, loss = step(grads, sharded[i], grads)
+    sharded[i] = new_s
+    return sharded[i], loss
+
+
+def sharded_other_slot_is_clean(sharded, i, j, grads):
+    # clean: a DIFFERENT slot's leaves were not donated
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    new_w, new_s, loss = step(grads, sharded[i], grads)
+    return sharded[j], new_s, loss

@@ -94,8 +94,8 @@ def test_svrg_module_trains():
     mod = SVRGModule(net, update_freq=2)
     train = io.NDArrayIter(x, y, batch_size=16, shuffle=True,
                            last_batch_handle="discard")
-    metric = mod.fit(train, optimizer_params=(("learning_rate", 0.3),),
-                     num_epoch=8)
+    metric = mod.fit(train, optimizer_params=(("learning_rate", 0.1),),
+                     num_epoch=16)
     name, acc = metric.get()
     assert acc > 0.85, acc
 

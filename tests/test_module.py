@@ -202,9 +202,9 @@ def test_sequential_module_trains():
              label_shapes=train.provide_label)
     seq.init_params(mx.init.Xavier())
     seq.init_optimizer(optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.3),))
+                       optimizer_params=(("learning_rate", 0.2),))
     m = mx.metric.Accuracy()
-    for _ in range(12):
+    for _ in range(30):
         train.reset()
         m.reset()
         for batch in train:

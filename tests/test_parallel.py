@@ -183,13 +183,13 @@ def test_scan_steps_then_call_interleave(mesh8):
 
 
 def test_psum_in_shard_map(mesh8):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import shard_map_compat
 
     def f(x):
         return parallel.psum(x, "dp")
 
-    fn = shard_map(f, mesh=mesh8, in_specs=P("dp"), out_specs=P())
+    fn = shard_map_compat(f, mesh=mesh8, in_specs=P("dp"), out_specs=P())
 
     x = jnp.arange(8.0)
     out = fn(x)

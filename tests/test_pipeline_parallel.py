@@ -132,7 +132,10 @@ def _lm_data(n_micro, mb, seq, seed=3):
     return xs, ys
 
 
-@pytest.mark.parametrize("nstage,n_micro", [(4, 6), (8, 8)])
+@pytest.mark.parametrize("nstage,n_micro", [
+    (4, 6),
+    pytest.param(8, 8, marks=pytest.mark.slow),  # full-mesh variant ~11 s; (4,6) covers the uneven-microbatch math in tier-1
+])
 def test_hetero_pipeline_loss_and_grads_match_sequential(nstage, n_micro):
     if len(jax.devices()) < nstage:
         pytest.skip("not enough devices")

@@ -150,11 +150,11 @@ def test_word_lm_descends():
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 0.05})
     losses = []
-    for _ in range(12):
+    for _ in range(8):
         with mx.autograd.record():
             out = net(data)
             loss = loss_fn(out, target)
         loss.backward()
         trainer.step(N)
         losses.append(float(loss.mean().asscalar()))
-    assert losses[-1] < losses[0] * 0.7, losses
+    assert losses[-1] < losses[0] * 0.8, losses

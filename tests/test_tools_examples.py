@@ -5,6 +5,7 @@ import re
 import subprocess
 import sys
 
+import jax
 import numpy as onp
 import pytest
 
@@ -154,6 +155,7 @@ def test_train_mnist_script_runs():
         or "final validation accuracy" in res.stdout
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_train_imagenet_benchmark_smoke():
     """tiny resnet18 on synthetic data — the north-star command shape."""
     script = os.path.join(REPO, "example", "image_classification",
@@ -168,6 +170,7 @@ def test_train_imagenet_benchmark_smoke():
     assert "benchmark:" in res.stderr or "benchmark:" in res.stdout
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_train_ssd_smoke():
     """SSD example trains on synthetic data and the loss descends
     (reference example/ssd/train.py capability)."""
@@ -183,6 +186,7 @@ def test_train_ssd_smoke():
     assert final and float(final[0].split()[1]) < 1.2, out.stdout[-400:]
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_word_lm_example_descends():
     """example/rnn/word_lm: scan-LSTM language model on a synthetic
     corpus — perplexity must descend well below the ~vocab-size start
@@ -201,6 +205,7 @@ def test_word_lm_example_descends():
     assert final and float(final[0].split()[1]) < 100.0, out.stdout[-400:]
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_bert_pretrain_example_descends():
     """example/bert/pretrain.py: masked-LM loss descends through the
     padded flash-attention path (BASELINE config 5 user story)."""
@@ -219,6 +224,7 @@ def test_bert_pretrain_example_descends():
     assert float(final[0].split()[1]) < first, (lines, final)
 
 
+@pytest.mark.slow   # true integration run (subprocess + fresh jax import); tier-1 covers the underlying paths in-process
 def test_quantization_example():
     """example/quantization: int8 rewrite keeps the toy accuracy."""
     out = subprocess.run(
@@ -233,6 +239,10 @@ def test_quantization_example():
     assert float(accs["INT8_ACC"]) > 0.85, accs
 
 
+@pytest.mark.skipif(
+    jax.__version_info__ < (0, 5, 0),
+    reason="XLA CPU backend lacks cross-process computations on jax<0.5 "
+           "— the dist_sync push is a cross-worker jitted reduction")
 def test_distributed_training_example():
     """example/distributed_training through the real launcher: 2 OS
     processes, dist_sync kvstore, both ranks converge."""
@@ -247,6 +257,7 @@ def test_distributed_training_example():
     assert codes == [0, 0], codes
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_dcgan_example_runs():
     """example/gan/dcgan.py: adversarial training through the
     Conv2DTranspose generator runs and the generator leaves its
@@ -264,6 +275,7 @@ def test_dcgan_example_runs():
     assert std > 0.02, "generator collapsed to a constant: std=%s" % std
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_bucketing_lm_example():
     """example/rnn/bucketing_lm: BucketingModule trains a shared-param
     LSTM LM across 4 length buckets, one compiled program per bucket
@@ -280,6 +292,7 @@ def test_bucketing_lm_example():
     assert "buckets compiled: 4" in out.stdout
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_finetune_example_loads_upstream_params():
     """example/image_classification/finetune.py: upstream-binary .params
     checkpoint -> feature transfer into a new-head zoo net -> frozen-
@@ -321,12 +334,13 @@ def test_flakiness_checker_runs_trials():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools",
                                       "flakiness_checker.py"),
-         "tests/test_ndarray.py::test_creation", "-n", "2"],
+         "tests/test_ndarray.py::test_creation", "-n", "1"],
         env=ENV, capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, out.stdout[-600:] + out.stderr[-400:]
-    assert "0/2 trials failed" in out.stdout
+    assert "0/1 trials failed" in out.stdout
 
 
+@pytest.mark.slow   # true integration run (subprocess + fresh jax import); tier-1 covers the underlying paths in-process
 def test_sparse_linear_classification_example():
     """Row-sparse logistic regression over LibSVMIter data descends
     (reference example/sparse/linear_classification)."""
@@ -342,6 +356,7 @@ def test_sparse_linear_classification_example():
     assert m and float(m.group(2)) < float(m.group(1)), txt[-500:]
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_sparse_matrix_factorization_example():
     """sparse_grad embedding MF descends (reference
     example/sparse/matrix_factorization)."""
@@ -356,6 +371,7 @@ def test_sparse_matrix_factorization_example():
     assert m and float(m.group(2)) < float(m.group(1)), txt[-500:]
 
 
+@pytest.mark.slow   # true integration run (subprocess + fresh jax import); tier-1 covers the underlying paths in-process
 def test_svm_mnist_example():
     """SVMOutput-head MLP trains to high accuracy on separable blobs
     (reference example/svm_mnist)."""
@@ -369,6 +385,7 @@ def test_svm_mnist_example():
     assert m and float(m.group(1)) > 0.9, txt[-500:]
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_profiler_example_writes_trace():
     """Profiler flow (set_config/run/stop/dump) produces xplane artifacts
     (reference example/profiler)."""
@@ -393,6 +410,7 @@ def test_bandwidth_probe_measures_links():
         assert r[k] > 0, (k, r)
 
 
+@pytest.mark.slow   # true integration run (subprocess + fresh jax import); tier-1 covers the underlying paths in-process
 def test_fgsm_adversarial_example():
     """FGSM input-gradient attack collapses accuracy (reference
     example/adversary)."""
@@ -407,6 +425,7 @@ def test_fgsm_adversarial_example():
         (res.stdout + res.stderr)[-400:]
 
 
+@pytest.mark.slow   # true integration run: minutes-scale subprocess; tier-1 covers the underlying paths in-process
 def test_autoencoder_example_reconstructs():
     """Autoencoder reconstructs far below the input-variance baseline
     (reference example/autoencoder)."""

@@ -131,7 +131,8 @@ def test_bert_small_trains():
     """MLM-style loss on bert_small descends under DataParallelStep."""
     rs = onp.random.RandomState(6)
     net = bert_small(vocab_size=500, max_length=64, dropout=0.0,
-                     use_pooler=False, use_decoder=True)
+                     use_pooler=False, use_decoder=True, num_layers=2,
+                     units=128, hidden_size=512)
     net.initialize(mx.init.Xavier())
     B, L = 4, 16
     tokens = mx.nd.array(rs.randint(0, 500, (B, L)).astype("float32"))
@@ -204,7 +205,8 @@ def test_bert_masked_positions_trains():
     rs = onp.random.RandomState(11)
     V, B, L, P = 120, 4, 24, 4
     net = bert_small(vocab_size=V, max_length=L, dropout=0.0,
-                     use_pooler=False, use_decoder=True)
+                     use_pooler=False, use_decoder=True, num_layers=2,
+                     units=128, hidden_size=512)
     net.initialize(mx.init.Xavier())
     tokens = mx.nd.array(rs.randint(5, V, (B, L)).astype("float32"))
     vl = mx.nd.array(onp.full(B, L, "int32"), dtype="int32")

@@ -28,7 +28,11 @@ def test_tutorials_exist():
 
 
 @pytest.mark.parametrize(
-    "path", _TUTORIALS, ids=[os.path.basename(p) for p in _TUTORIALS])
+    "path",
+    [pytest.param(p, marks=pytest.mark.slow)
+     if os.path.basename(p).startswith("07_") else p
+     for p in _TUTORIALS],   # 07_performance compiles bench-scale steps (~9 s); content is covered by bench protocol tests
+    ids=[os.path.basename(p) for p in _TUTORIALS])
 def test_tutorial_executes(path):
     blocks = _blocks(path)
     assert blocks, "tutorial %s has no python blocks" % path

@@ -82,9 +82,11 @@ def main(argv=None):
                 onp.tile(host[None], (len(devs), 1)),
                 NamedSharding(mesh, P("dp", None)))
 
+            from mxnet_tpu.parallel.mesh import shard_map_compat
+
             @jax.jit
             def ar(v):
-                return jax.shard_map(
+                return shard_map_compat(
                     lambda s: jax.lax.psum(s, "dp"), mesh=mesh,
                     in_specs=P("dp", None), out_specs=P(None, None))(v)
             # algorithmic bytes: each device contributes its shard once

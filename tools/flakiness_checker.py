@@ -202,6 +202,11 @@ def main(argv=None):
     if unrunnable == args.num_trials:
         print("error: no trial produced test results", file=sys.stderr)
         return 2
+    failed_trials = sum(
+        1 for statuses in trial_results
+        if any(s in ("fail", "error") for s in statuses.values()))
+    print("%d/%d trials failed; %d flaky test(s)"
+          % (failed_trials, args.num_trials, len(flaky)), flush=True)
     return 1 if flaky else 0
 
 

@@ -34,12 +34,31 @@ ALL = object()
 
 
 def _expr_key(node: ast.expr) -> Optional[str]:
-    """Dotted key for Name/attribute chains: 'x', 'self._opt_states'."""
+    """Dotted key for Name/attribute/subscript chains: 'x',
+    'self._opt_states', 'self._sharded[i]', 'states[0]'.
+
+    Subscripts cover the ZeRO sharded-update layout, where the donated
+    carries are CONTAINER ENTRIES (per-slot lists of dp-sharded state
+    leaves indexed by weight slot) rather than whole locals — a donation
+    of ``self._sharded[i]`` must taint later reads of that entry, and a
+    rebinding store ``self._sharded[i] = new`` must kill the taint.
+    Only constant and simple-name indices are keyed; anything fancier
+    stays untracked (conservative: no false positives from aliasing)."""
     if isinstance(node, ast.Name):
         return node.id
     if isinstance(node, ast.Attribute):
         base = _expr_key(node.value)
         return None if base is None else base + "." + node.attr
+    if isinstance(node, ast.Subscript):
+        base = _expr_key(node.value)
+        if base is None:
+            return None
+        sl = node.slice
+        if isinstance(sl, ast.Constant):
+            return "%s[%r]" % (base, sl.value)
+        if isinstance(sl, ast.Name):
+            return "%s[%s]" % (base, sl.id)
+        return None
     return None
 
 
@@ -167,7 +186,7 @@ def _analyze_function(module, index, fi, findings):
                 k = _expr_key(node.func.value)
                 if k is not None:
                     borrows.append((k, node.lineno))
-        if isinstance(node, (ast.Name, ast.Attribute)):
+        if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
             k = _expr_key(node)
             if k is None:
                 continue
