@@ -195,8 +195,7 @@ class DataParallelStep:
             self._opt_states.append(
                 [jax.device_put(l._data, wdev) if wdev is not None
                  else l._data for l in leaves])
-        if self._shard_n:
-            self._report_shard_layout()
+        self._report_shard_layout()
         self._t = optimizer.begin_num_update
         self._cache = {}
         # device-resident per-call operands: a tiny host->device transfer
@@ -286,15 +285,18 @@ class DataParallelStep:
         return total
 
     def _report_shard_layout(self):
-        """Gauge the per-chip state footprint and journal the collective
-        schedule the sharded update compiles to (the collectives run
-        inside XLA, so the journal records the schedule, not per-step
-        host timings)."""
+        """Gauge the per-chip state footprint (both layouts — the
+        replicated number is what the ZeRO sharding shrinks) and, when
+        sharded, journal the collective schedule the update compiles to
+        (the collectives run inside XLA, so the journal records the
+        schedule, not per-step host timings)."""
         per_chip = self.optimizer_state_bytes(per_chip=True)
         total = self.optimizer_state_bytes(per_chip=False)
         telemetry.gauge("parallel.optimizer_state_bytes_per_chip",
                         per_chip)
         telemetry.gauge("parallel.optimizer_state_bytes_total", total)
+        if not self._shard_n:
+            return
         rs_bytes = ag_bytes = 0
         for slot, i in enumerate(self._trainable):
             if not self._shard_slots[slot]:
@@ -313,6 +315,70 @@ class DataParallelStep:
             - sum(self._shard_slots),
             state_bytes_per_chip=per_chip, state_bytes_total=total,
             reduce_scatter_bytes=rs_bytes, all_gather_bytes=ag_bytes)
+
+    def hbm_estimate(self, activations=()):
+        """Static per-chip HBM estimate of this step's resident leaves
+        (params, optimizer state, batch), computed from shapes/dtypes
+        and the per-slot layout flags via ``tools.lint.hbm`` — the SAME
+        arithmetic graftlint and the autotuner use, independently of
+        what the runtime allocated (cross-checked against the
+        ``optimizer_state_bytes_per_chip`` gauges in
+        ``tests/test_hbm_estimator.py``).
+
+        ``activations``: ``(shape, dtype)`` pairs for the dp-sharded
+        batch leaves of one jitted signature.  Returns a dict of
+        per-chip byte counts, or None when ``tools.lint`` is not
+        importable (installed package without the repo's tools/).
+        """
+        try:
+            from tools.lint import hbm
+        except ImportError:
+            return None
+        n = self._shard_n or 1
+        # the batch is dp-sharded whenever the mesh has a dp axis —
+        # independent of whether the ZeRO state sharding is on
+        dp = 1
+        if self._mesh is not None and \
+                "dp" in getattr(self._mesh, "axis_names", ()):
+            dp = int(self._mesh.shape["dp"])
+        params_b = 0
+        for p in self._params:
+            d = p.data()
+            params_b += hbm.leaf_bytes_per_chip(
+                tuple(d.shape), str(d.dtype), hbm.REPLICATED, n)
+        state_b = 0
+        for slot, leaves in enumerate(self._opt_states):
+            layout = hbm.DP_SHARDED if self._shard_slots[slot] \
+                else hbm.REPLICATED
+            w = self._params[self._trainable[slot]].data()
+            sdtype = "float32" if self._mp_slots[slot] else str(w.dtype)
+            state_b += len(leaves) * hbm.leaf_bytes_per_chip(
+                self._shard_meta[slot], sdtype, layout, n)
+        act_b = 0
+        for shape, dtype in activations:
+            nelem = 1
+            for d in shape:
+                nelem *= int(d)
+            act_b += nelem * hbm.dtype_itemsize(dtype) // dp
+        return {"params_bytes_per_chip": params_b,
+                "opt_state_bytes_per_chip": state_b,
+                "activation_bytes_per_chip": act_b,
+                "total_bytes_per_chip": params_b + state_b + act_b,
+                "n_shards": n}
+
+    def _journal_hbm_estimate(self, dval, lval, scan):
+        """One ``hbm/estimate`` journal event per jitted program (fires
+        with the cache-miss, so every compiled signature gets its
+        bytes-per-chip record; rendered by tools/parse_log.py)."""
+        leaves = list(dval) if isinstance(dval, tuple) else [dval]
+        leaves.append(lval)
+        acts = [(tuple(v.shape), str(v.dtype)) for v in leaves
+                if v is not None]
+        est = self.hbm_estimate(activations=acts)
+        if est is not None:
+            telemetry.event("hbm", "estimate",
+                            program="DataParallelStep[%x]" % id(self),
+                            mode="scan" if scan else "call", **est)
 
     # ------------------------------------------------------------------
     def __call__(self, data, label):
@@ -423,6 +489,7 @@ class DataParallelStep:
                  "data": ([sig_d(d) for d in dval]
                           if isinstance(dval, tuple) else sig_d(dval)),
                  "label": sig_d(lval)})
+            self._journal_hbm_estimate(dval, lval, scan)
             jfn = self._build(scan=scan)
             self._cache[key] = jfn
         self._t += lead
@@ -592,8 +659,6 @@ class DataParallelStep:
             for slot, (i, g) in enumerate(zip(trainable, grads)):
                 st_leaves = opt_states[slot]
                 if shard_slots[slot]:
-                    # graftlint: disable-next=retrace-closure-array --
-                    # shard flags are per-slot constants fixed at build
                     new_pvals[i], new_st = sharded_update(
                         slot, i, pvals[i], g, t, lrs, st_leaves)
                     new_states.append(new_st)

@@ -1,0 +1,161 @@
+"""sharding fixture: seeded mesh-axis / collective / carry violations.
+
+Each violation line carries an expect-rule marker asserted exactly by
+tests/test_lint.py.  The clean twins next to each seeded bug
+pin the checker's precision: symbol-threaded axis names, balanced
+padded collective pairs, uniform branch collectives and stable carry
+shardings must stay silent.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mxnet_tpu.parallel.collectives import (all_gather_unpad,
+                                            reduce_scatter_padded)
+
+
+def make_mesh(devices):
+    return Mesh(devices, ("dp", "tp"))
+
+
+# -- mesh-axis consistency ---------------------------------------------------
+
+def axis_typo(mesh, x):
+    def body(xb):
+        return lax.psum(xb, "pd")  # expect: shard-axis-unknown
+    return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                     out_specs=P())(x)
+
+
+def axis_ok_literal(mesh, x):
+    def body(xb):
+        return lax.psum(xb, "dp")
+    return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                     out_specs=P())(x)
+
+
+def axis_ok_symbol(mesh, x, axis="tp"):
+    # clean: the axis rides ONE symbol through specs and body, the
+    # moe/pipeline idiom — consistency is what matters, not literals
+    def body(xb):
+        return lax.all_gather(xb, axis, axis=0, tiled=True)
+    return shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                     out_specs=P())(x)
+
+
+def spec_axis_typo(mesh, x):
+    sharding = NamedSharding(mesh, P("qq"))  # expect: shard-axis-unknown
+    return jax.device_put(x, sharding)
+
+
+# -- PartitionSpec rank vs statically-known array rank -----------------------
+
+def spec_rank_bad(mesh, x):
+    flat = x.reshape(-1)
+    sharding = NamedSharding(mesh, P("dp", None))
+    return jax.lax.with_sharding_constraint(flat, sharding)  # expect: shard-spec-rank
+
+
+def spec_rank_ok(mesh, x):
+    flat = x.reshape(-1)
+    return jax.lax.with_sharding_constraint(flat, NamedSharding(mesh,
+                                                                P("dp")))
+
+
+# -- reduce_scatter_padded / all_gather_unpad pairing ------------------------
+
+def pairing_size_bad():
+    g = jnp.zeros((100,))
+    s = reduce_scatter_padded(g, "dp", axis_size=8)
+    return all_gather_unpad(s, (17, 3), "dp")  # expect: shard-collective-pairing
+
+
+def pairing_axis_bad(g):
+    s = reduce_scatter_padded(g, "dp", axis_size=8)
+    return all_gather_unpad(s, (64,), "tp")  # expect: shard-collective-pairing
+
+
+def pairing_ok():
+    g = jnp.zeros((100,))
+    s = reduce_scatter_padded(g, "dp", axis_size=8)
+    return all_gather_unpad(s, (100,), "dp")
+
+
+# -- collective issue order (the multi-host deadlock shapes) -----------------
+
+def order_divergent(mesh, x):
+    def body(xb):
+        r = lax.axis_index("dp")
+        if r == 0:  # expect: shard-collective-order, trace-tracer-branch
+            xb = lax.psum(xb, "dp")
+        return xb
+    return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                     out_specs=P("dp"))(x)
+
+
+def order_branch_mismatch(mesh, x, swap):
+    def body(xb):
+        if swap:  # expect: shard-collective-order
+            a = lax.psum(xb, "dp")
+            b = lax.all_gather(xb, "dp", axis=0, tiled=True)
+        else:
+            b = lax.all_gather(xb, "dp", axis=0, tiled=True)
+            a = lax.psum(xb, "dp")
+        return a + jnp.sum(b)
+    return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                     out_specs=P("dp"))(x)
+
+
+def order_cond_asymmetric(mesh, x):
+    def with_coll(v):
+        return lax.psum(v, "dp")
+
+    def without_coll(v):
+        return v * 2.0
+
+    def body(xb):
+        return lax.cond(jnp.sum(xb) > 0, with_coll, without_coll, xb)  # expect: shard-collective-order
+    return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                     out_specs=P("dp"))(x)
+
+
+def order_uniform_is_clean(mesh, x, causal):
+    # clean: the same collective sequence on both paths, and a
+    # config branch that only changes local math
+    def body(xb):
+        if causal:
+            xb = xb * 0.5
+        return lax.psum(xb, "dp")
+    return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                     out_specs=P())(x)
+
+
+# -- scan-carry sharding stability -------------------------------------------
+
+def carry_reshard(params, xs):
+    SHARD = P("dp")
+    REPL = P()
+
+    def body(carry, x):
+        w, t = carry
+        w = jax.lax.with_sharding_constraint(w, SHARD)
+        w = w + x
+        w_out = jax.lax.with_sharding_constraint(w, REPL)  # expect: shard-carry-reshard
+        return (w_out, t + 1), w_out
+
+    return lax.scan(body, (params, 0), xs)
+
+
+def carry_stable_is_clean(params, xs):
+    SHARD = P("dp")
+
+    def body(carry, x):
+        w, t = carry
+        w = jax.lax.with_sharding_constraint(w, SHARD)
+        w = w + x
+        w_out = jax.lax.with_sharding_constraint(w, SHARD)
+        return (w_out, t + 1), w_out
+
+    return lax.scan(body, (params, 0), xs)

@@ -1,0 +1,108 @@
+"""KV-heartbeat liveness fallback edges (PR 5's kill-test machinery).
+
+``num_dead_node`` falls back to the ``mxtpu/hb/<rank>`` heartbeat
+records when the jax coordination client has no ``get_live_nodes``.
+These are the unit-level edge cases no multiprocess run covers: stale
+and garbled timestamp payloads, peers that never wrote a record, and a
+coordinator that flaps (raises) partway through the scan — none of
+which may crash the query; they count the affected peer dead and move
+on.
+"""
+import time
+
+import jax
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+
+
+class FakeClient:
+    """Coordinator KV-store stand-in WITHOUT get_live_nodes (forces the
+    heartbeat fallback path)."""
+
+    def __init__(self, records=None, fail_on=()):
+        self.records = dict(records or {})
+        self.fail_on = set(fail_on)
+        self.calls = 0
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        self.calls += 1
+        assert timeout_ms >= 50, "per-peer budget must stay readable"
+        if key in self.fail_on:
+            raise RuntimeError("coordination service flapped")
+        if key not in self.records:
+            raise KeyError(key)
+        return self.records[key]
+
+
+def test_fresh_heartbeats_count_alive():
+    now = time.time()
+    c = FakeClient({kvs._HB_KEY % 1: repr(now),
+                    kvs._HB_KEY % 2: repr(now)})
+    assert kvs._heartbeat_dead_count(c, [0, 1, 2], timeout=1) == 0
+
+
+def test_stale_heartbeat_counts_dead():
+    now = time.time()
+    c = FakeClient({kvs._HB_KEY % 1: repr(now - 1e4),
+                    kvs._HB_KEY % 2: repr(now)})
+    assert kvs._heartbeat_dead_count(c, [0, 1, 2], timeout=1) == 1
+
+
+@pytest.mark.parametrize("payload", ["definitely-not-a-timestamp", "",
+                                     "1.2.3", b"\xff\xfe"])
+def test_garbled_payload_counts_dead_without_crashing(payload):
+    """A corrupt heartbeat record (torn write, wrong encoding) is a dead
+    peer, not an exception out of num_dead_node."""
+    now = time.time()
+    c = FakeClient({kvs._HB_KEY % 1: payload,
+                    kvs._HB_KEY % 2: repr(now)})
+    assert kvs._heartbeat_dead_count(c, [0, 1, 2], timeout=1) == 1
+
+
+def test_bytes_timestamp_payload_is_readable():
+    # the coordination service may hand back bytes; a well-formed
+    # timestamp still parses
+    now = time.time()
+    c = FakeClient({kvs._HB_KEY % 1: repr(now).encode()})
+    assert kvs._heartbeat_dead_count(c, [0, 1], timeout=1) == 0
+
+
+def test_missing_peer_counts_dead():
+    c = FakeClient({})
+    assert kvs._heartbeat_dead_count(c, [0, 1], timeout=1) == 1
+
+
+def test_flapping_coordinator_mid_scan_does_not_crash():
+    """Peer 1's record reads fine, peer 2's read blows up mid-scan
+    (coordinator restart), peer 3's record is fine again — only the
+    flapped read counts dead."""
+    now = time.time()
+    c = FakeClient({kvs._HB_KEY % 1: repr(now),
+                    kvs._HB_KEY % 3: repr(now)},
+                   fail_on={kvs._HB_KEY % 2})
+    assert kvs._heartbeat_dead_count(c, [0, 1, 2, 3], timeout=1) == 1
+
+
+def test_own_rank_never_polled():
+    """The querying process must not read (or misjudge) its own record
+    — jax.process_index() is excluded from the scan."""
+    c = FakeClient({})     # nothing written, including rank 0 (me)
+    assert kvs._heartbeat_dead_count(c, [0], timeout=1) == 0
+    assert c.calls == 0
+
+
+def test_num_dead_node_uses_heartbeat_fallback(monkeypatch):
+    """End-to-end through KVStoreTPU.num_dead_node: a client without
+    get_live_nodes routes into the heartbeat scan and survives a
+    flapping coordinator."""
+    from jax._src import distributed as _dist
+    now = time.time()
+    client = FakeClient({kvs._HB_KEY % 1: repr(now - 1e5)},
+                        fail_on={kvs._HB_KEY % 2})
+    monkeypatch.setattr(_dist.global_state, "client", client,
+                        raising=False)
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    kv = kvs.KVStoreTPU.__new__(kvs.KVStoreTPU)
+    assert kv.num_dead_node(timeout=1) == 2     # stale + flapped

@@ -41,7 +41,8 @@ def _lint_fixture(name):
 
 
 @pytest.mark.parametrize("name", ["fx_trace.py", "fx_retrace.py",
-                                  "fx_donation.py", "fx_pallas.py"])
+                                  "fx_donation.py", "fx_pallas.py",
+                                  "fx_sharding.py"])
 def test_fixture_rules_and_lines(name):
     path, result = _lint_fixture(name)
     got = {(f.rule, f.line) for f in result.new}
@@ -155,14 +156,24 @@ def test_baseline_diff_multiplicity(tmp_path):
     assert len(new) == 1 and len(old) == 1
 
 
-def test_package_gate_zero_findings():
-    """THE tier-1 gate: zero new findings over mxnet_tpu/, and the run
+@pytest.fixture(scope="module")
+def package_scan():
+    """THE tier-1 full-package scan — baseline + suppression audit +
+    telemetry in ONE run (~5 s) shared by the gate, stale-suppression
+    and changed-mode tests."""
+    baseline = os.path.join(REPO, "tools", "lint", "baseline.json")
+    return run_lint([os.path.join(REPO, "mxnet_tpu")],
+                    baseline_path=baseline if os.path.exists(baseline)
+                    else None, emit_telemetry=True,
+                    audit_suppressions=True)
+
+
+def test_package_gate_zero_findings(package_scan):
+    """THE tier-1 gate: zero new findings over mxnet_tpu/ (stale
+    suppressions included — the audit rides the gate scan), and the run
     is journaled into telemetry (lint.findings counter + lint event)."""
     from mxnet_tpu import telemetry
-    baseline = os.path.join(REPO, "tools", "lint", "baseline.json")
-    result = run_lint([os.path.join(REPO, "mxnet_tpu")],
-                      baseline_path=baseline if os.path.exists(baseline)
-                      else None, emit_telemetry=True)
+    result = package_scan
     assert result.files, "package scan found no files"
     msg = "\n".join(f.render() for f in result.new)
     assert not result.new, (
@@ -215,6 +226,120 @@ def test_cli_json_and_exit_codes(tmp_path):
     assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
     data = json.loads(res.stdout)
     assert data["counts"]["new"] == 0
+
+
+def test_seeded_mesh_axis_bug_fails_the_gate(tmp_path):
+    """Acceptance: renaming ONE mesh axis in a pristine parallel/ file
+    must trip the sharding checker.  The unmodified copy stays clean —
+    the finding comes from the seeded bug, not fixture noise."""
+    src = open(os.path.join(REPO, "mxnet_tpu", "parallel",
+                            "moe.py")).read()
+    clean = tmp_path / "moe_clean.py"
+    clean.write_text(src)
+    result = run_lint([str(clean)], baseline_path=None)
+    assert not result.new, "\n".join(f.render() for f in result.new)
+
+    bugged = src.replace("recv = lax.all_to_all(send, axis,",
+                         'recv = lax.all_to_all(send, "dp",')
+    assert bugged != src, "seeding site moved — update the test"
+    bad = tmp_path / "moe_bug.py"
+    bad.write_text(bugged)
+    result = run_lint([str(bad)], baseline_path=None)
+    rules = {f.rule for f in result.new}
+    assert "shard-axis-unknown" in rules, \
+        "\n".join(f.render() for f in result.new)
+
+
+def test_stale_suppression_audit(tmp_path):
+    """A suppression whose rule fires is kept quiet; one whose rule no
+    longer fires on its line is flagged by --audit-suppressions (and
+    stays invisible without the flag — the tier-1 gate is unchanged)."""
+    src = (
+        "import jax\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = float(x)  # graftlint: disable=trace-host-sync -- used\n"
+        "    b = x + 1  # graftlint: disable=trace-host-sync -- stale\n"
+        "    c = float(x)  # graftlint: disable=trace-host-sync,"
+        "retrace-jit-in-loop -- half\n"
+        "    return a + b + c\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    quiet = run_lint([str(p)], baseline_path=None)
+    assert not quiet.new, [f.render() for f in quiet.new]
+    audited = run_lint([str(p)], baseline_path=None,
+                       audit_suppressions=True)
+    got = [(f.rule, f.line) for f in audited.new]
+    # line 7: fully stale; line 8: multi-rule suppression whose
+    # trace-host-sync half is live but whose retrace half is dead —
+    # staleness is per RULE, not per comment
+    assert got == [("lint-stale-suppression", 7),
+                   ("lint-stale-suppression", 8)], got
+    stale_msgs = [f.message for f in audited.new]
+    assert any("retrace-jit-in-loop" in m and "trace-host-sync" not in m
+               for m in stale_msgs), stale_msgs
+    # a rules allowlist disables the audit (unrelated suppressions
+    # would read as stale)
+    filtered = run_lint([str(p)], baseline_path=None, rules=["pallas-"],
+                        audit_suppressions=True)
+    assert not filtered.new
+
+
+def test_package_suppressions_not_stale(package_scan):
+    """Satellite: every inline suppression in mxnet_tpu/ must still
+    suppress a live finding — the audit re-validates what PR 4
+    grandfathered by hand."""
+    stale = [f for f in package_scan.new
+             if f.rule == "lint-stale-suppression"]
+    assert not stale, "\n".join(f.render() for f in stale)
+
+
+def test_changed_mode_matches_full_run(package_scan):
+    """Acceptance: a --changed run over one file reports exactly the
+    findings a full-package run reports for that file (the index is
+    still cross-file, only the checker pass narrows), inside the 10 s
+    budget."""
+    import time
+    target = "mxnet_tpu/parallel/collectives.py"
+    t0 = time.time()
+    fast = run_lint([os.path.join(REPO, "mxnet_tpu")],
+                    baseline_path=None, changed_files=[target],
+                    audit_suppressions=True)
+    elapsed = time.time() - t0
+    assert target in fast.files
+    full = package_scan
+
+    def in_file(result):
+        return sorted((f.rule, f.line) for f in
+                      result.new + result.suppressed
+                      if f.path == target)
+
+    assert in_file(fast) == in_file(full)
+    # the closure pulls in importers of collectives.py, but not the
+    # whole package
+    assert len(fast.files) < len(full.files)
+    assert elapsed < 10.0, "changed-mode run took %.1fs" % elapsed
+
+
+def test_reverse_dependency_closure(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("from . import a\n")
+    (pkg / "a.py").write_text("from .b import f\n")
+    (pkg / "b.py").write_text("def f():\n    return 1\n")
+    (pkg / "c.py").write_text("import os\n")
+    from tools.lint.core import collect_files, ModuleInfo
+    from tools.lint.jitgraph import PackageIndex
+    mods = []
+    for p in collect_files([str(tmp_path)]):
+        rel = os.path.relpath(p, str(tmp_path))
+        mods.append(ModuleInfo(p, rel, open(p).read()))
+    idx = PackageIndex(mods)
+    got = idx.reverse_dependency_closure({"pkg/b.py"})
+    assert got == {"pkg/b.py", "pkg/a.py", "pkg/__init__.py"}, got
+    assert idx.reverse_dependency_closure({"pkg/c.py"}) == {"pkg/c.py"}
 
 
 def test_rule_catalog_documented():

@@ -99,6 +99,67 @@ def test_parse_log_jsonl_roundtrip(tmp_path):
     assert "shape[0]: 4 -> 8" in out
 
 
+def test_parse_log_lint_report_rule_families():
+    """--lint renders rules grouped by checker family — the sharding
+    family lands in its own rows."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json
+    import parse_log
+    report = {
+        "counts": {"new": 2, "baselined": 0, "suppressed": 1, "total": 3},
+        "findings": [
+            {"rule": "shard-axis-unknown", "path": "m.py", "line": 3,
+             "col": 0, "message": "axis 'pd' undeclared",
+             "context": "f"},
+            {"rule": "trace-host-sync", "path": "m.py", "line": 9,
+             "col": 0, "message": "float() sync", "context": "g"},
+        ],
+    }
+    agg = parse_log.parse_lint(json.dumps(report))
+    assert agg["by_rule"] == {"shard-axis-unknown": 1,
+                              "trace-host-sync": 1}
+    out = parse_log.render_lint(agg)
+    assert "| sharding | shard-axis-unknown | 1 |" in out
+    assert "| trace-safety | trace-host-sync | 1 |" in out
+    assert "axis 'pd' undeclared" in out
+
+
+def test_parse_log_hbm_journal_table(tmp_path):
+    """The hbm/estimate journal events render as a bytes-per-chip table
+    per compiled program — via --jsonl, and via --lint when handed the
+    telemetry journal (gate event supplies the counts)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    from mxnet_tpu import telemetry
+
+    telemetry.reset()
+    telemetry.event("hbm", "estimate", program="DataParallelStep[abc]",
+                    mode="call", params_bytes_per_chip=4 * 1048576,
+                    opt_state_bytes_per_chip=1048576,
+                    activation_bytes_per_chip=524288,
+                    total_bytes_per_chip=5 * 1048576 + 524288,
+                    n_shards=8)
+    telemetry.event("lint", "gate", new=0, baselined=0, suppressed=51,
+                    files=139)
+    path = tmp_path / "journal.jsonl"
+    telemetry.export_jsonl(str(path))
+    telemetry.reset()
+
+    with open(path) as f:
+        agg = parse_log.parse_jsonl(f)
+    assert "DataParallelStep[abc]/call" in agg["hbm"]
+    out = parse_log.render_jsonl(agg)
+    assert "static HBM estimate" in out
+    assert "DataParallelStep[abc]" in out
+    assert "| 4 | 1 | 0.5 | 5.5 | 8 |" in out
+
+    lint_agg = parse_log.parse_lint(open(path).read())
+    assert lint_agg["counts"]["suppressed"] == 51
+    lint_out = parse_log.render_lint(lint_agg)
+    assert "static HBM estimate" in lint_out
+    assert "| 8 |" in lint_out
+
+
 def test_im2rec_roundtrip(tmp_path):
     cv2 = pytest.importorskip("cv2")
     root = tmp_path / "imgs"

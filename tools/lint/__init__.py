@@ -1,7 +1,7 @@
 """graftlint — framework-aware static analysis for the mxnet-tpu JAX
 training stack.
 
-Four checkers (see docs/LINTING.md for the rule catalog):
+Five checkers (see docs/LINTING.md for the rule catalog):
 
 * trace-safety  — host-sync escapes inside jit-reachable code
 * retrace       — static recompile hazards (the compile-time complement
@@ -9,18 +9,25 @@ Four checkers (see docs/LINTING.md for the rule catalog):
 * donation      — use-after-donate dataflow over donate_argnums users
 * pallas        — BlockSpec/grid/index-map consistency + static VMEM
                   footprint vs. the tune_attention_blocks clamp budget
+* sharding      — mesh-axis consistency, collective pairing/order
+                  (deadlock shapes) and scan-carry sharding stability
+                  over the ``parallel/`` layer; its companion static
+                  per-chip HBM estimator lives in ``tools.lint.hbm``
 
-Run ``python -m tools.lint mxnet_tpu/`` (text or ``--format json``).
-Findings are suppressed inline with a mandatory reason::
+Run ``python -m tools.lint mxnet_tpu/`` (text or ``--format json``);
+``--changed`` lints only files touched vs ``git merge-base HEAD main``
+plus their reverse-dependency closure.  Findings are suppressed inline
+with a mandatory reason::
 
     x = float(v)  # graftlint: disable=trace-host-sync -- epoch boundary
 
 or grandfathered in ``tools/lint/baseline.json``; the tier-1 gate
-(``tests/test_lint.py``) fails on any new unsuppressed finding.
+(``tests/test_lint.py``) fails on any new unsuppressed finding, and
+``--audit-suppressions`` flags suppressions whose rule no longer fires.
 """
 from __future__ import annotations
 
-from . import donation, pallas, retrace, trace_safety
+from . import donation, pallas, retrace, sharding, trace_safety
 from .core import (Finding, LintResult, ModuleInfo, default_baseline_path,
                    diff_baseline, load_baseline, run_lint, write_baseline)
 
@@ -28,7 +35,7 @@ __all__ = ["CHECKERS", "all_rules", "run_lint", "Finding", "LintResult",
            "ModuleInfo", "load_baseline", "write_baseline",
            "diff_baseline", "default_baseline_path"]
 
-CHECKERS = (trace_safety, retrace, donation, pallas)
+CHECKERS = (trace_safety, retrace, donation, pallas, sharding)
 
 # rules owned by the runner itself (suppression hygiene)
 _META_RULES = {
@@ -36,6 +43,9 @@ _META_RULES = {
         "graftlint suppression without a '-- <reason>' clause",
     "lint-unknown-rule": "suppression names an unknown rule id",
     "lint-parse-error": "file could not be parsed/read",
+    "lint-stale-suppression":
+        "suppression whose rule no longer fires on its line "
+        "(--audit-suppressions / --write-baseline)",
 }
 
 

@@ -1,16 +1,44 @@
 """CLI: ``python -m tools.lint [paths] [--format json] [...]``.
 
 Exit codes: 0 = clean (no new findings), 1 = new findings, 2 = usage.
+
+``--changed`` is the pre-commit fast mode: lint only files touched vs
+``git merge-base HEAD main`` (plus untracked files) and their
+reverse-dependency closure.  The cross-file index is still built over
+the full path set, so the findings in the reported files are identical
+to a full run's.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from . import all_rules
-from .core import default_baseline_path, run_lint, write_baseline
+from .core import (_repo_root, default_baseline_path, run_lint,
+                   write_baseline)
+
+
+def _git_changed_files(root: str):
+    """Repo-relative .py files changed vs merge-base(HEAD, main), plus
+    untracked ones.  Returns None when git is unavailable (caller falls
+    back to a full run)."""
+    def git(*args):
+        return subprocess.run(("git",) + args, cwd=root,
+                              capture_output=True, text=True)
+    mb = git("merge-base", "HEAD", "main")
+    base = mb.stdout.strip() if mb.returncode == 0 and mb.stdout.strip() \
+        else "HEAD"
+    diff = git("diff", "--name-only", "-z", base, "--")
+    if diff.returncode != 0:
+        return None
+    names = [n for n in diff.stdout.split("\0") if n]
+    untracked = git("ls-files", "--others", "--exclude-standard", "-z")
+    if untracked.returncode == 0:
+        names += [n for n in untracked.stdout.split("\0") if n]
+    return sorted({n for n in names if n.endswith(".py")})
 
 
 def main(argv=None) -> int:
@@ -35,6 +63,14 @@ def main(argv=None) -> int:
                     help="also print grandfathered findings")
     ap.add_argument("--telemetry", action="store_true",
                     help="emit lint.findings into the telemetry journal")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs merge-base(HEAD, "
+                         "main) plus their reverse-dependency closure "
+                         "(pre-commit fast mode)")
+    ap.add_argument("--audit-suppressions", action="store_true",
+                    help="flag inline suppressions whose rule no longer "
+                         "fires on their line (always on under "
+                         "--write-baseline)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -55,16 +91,45 @@ def main(argv=None) -> int:
     rules = [r.strip() for r in args.rules.split(",")] if args.rules \
         else None
 
+    if args.changed and args.write_baseline:
+        # a narrowed scan would rewrite the baseline WITHOUT the
+        # grandfathered entries of every out-of-closure file
+        print("error: --changed cannot be combined with "
+              "--write-baseline (the baseline must come from a full "
+              "scan)", file=sys.stderr)
+        return 2
+
+    changed = None
+    if args.changed:
+        changed = _git_changed_files(_repo_root())
+        if changed is None:
+            print("warning: git unavailable, falling back to a full "
+                  "run", file=sys.stderr)
+        elif not changed:
+            print("graftlint: no .py files changed vs merge-base — "
+                  "nothing to lint")
+            return 0
+
     result = run_lint(paths, baseline_path=baseline, rules=rules,
-                      emit_telemetry=args.telemetry)
+                      emit_telemetry=args.telemetry,
+                      changed_files=changed,
+                      audit_suppressions=(args.audit_suppressions
+                                          or args.write_baseline))
 
     if args.write_baseline:
+        # stale-suppression findings are REPORTED, never grandfathered:
+        # baselining them would defeat the audit
+        stale = [f for f in result.new
+                 if f.rule == "lint-stale-suppression"]
+        keep = [f for f in result.new + result.baselined
+                if f.rule != "lint-stale-suppression"]
         path = args.baseline or default_baseline_path()
-        data = write_baseline(path, result.new + result.baselined)
+        data = write_baseline(path, keep)
+        for f in stale:
+            print(f.render())
         print("wrote %d baseline entries (%d findings) to %s"
-              % (len(data["entries"]),
-                 len(result.new) + len(result.baselined), path))
-        return 0
+              % (len(data["entries"]), len(keep), path))
+        return 1 if stale else 0
 
     if args.format == "json":
         json.dump(result.to_dict(), sys.stdout, indent=1)

@@ -63,6 +63,9 @@ class Suppression:
     reason: Optional[str]
     comment_line: int    # line the comment itself is on
     used: bool = False
+    # rules that actually matched a finding — the audit flags per RULE,
+    # so one dead rule in a multi-rule suppression is still caught
+    used_rules: set = field(default_factory=set)
 
 
 class ModuleInfo:
@@ -272,6 +275,7 @@ def _apply_suppressions(module: ModuleInfo, findings: List[Finding],
                 break
         if hit is not None:
             hit.used = True
+            hit.used_rules.add(f.rule)
             suppressed.append(f)
         else:
             kept.append(f)
@@ -280,7 +284,9 @@ def _apply_suppressions(module: ModuleInfo, findings: List[Finding],
 
 def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
              rules: Optional[Sequence[str]] = None,
-             emit_telemetry: bool = False) -> LintResult:
+             emit_telemetry: bool = False,
+             changed_files: Optional[Sequence[str]] = None,
+             audit_suppressions: bool = False) -> LintResult:
     """Run every checker over ``paths``.
 
     ``baseline_path``: JSON baseline consumed by :func:`diff_baseline`
@@ -288,6 +294,17 @@ def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
     ``rules``: optional rule-id allowlist.  ``emit_telemetry``: bump the
     ``lint.findings`` counter + journal an event via mxnet_tpu.telemetry
     (best-effort import; used by the tier-1 gate).
+
+    ``changed_files``: repo-relative paths — the cross-file index is
+    still built over ALL of ``paths`` (jit-reachability and config
+    inference need every caller), but checkers only run on the changed
+    files plus their reverse-dependency closure, so findings in the
+    reported files match a full run exactly.
+
+    ``audit_suppressions``: report every ``# graftlint: disable``
+    comment whose rule no longer fires on its line as a
+    ``lint-stale-suppression`` meta finding (skipped when a ``rules``
+    allowlist is active — unrelated suppressions would read as stale).
     """
     from . import CHECKERS, all_rules
     from .jitgraph import PackageIndex
@@ -309,13 +326,26 @@ def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
                 rule="lint-parse-error", path=rel.replace(os.sep, "/"),
                 line=getattr(e, "lineno", 0) or 0, col=0,
                 message="cannot analyze file: %s" % (e,)))
-    result.files = [m.relpath for m in modules]
 
     index = PackageIndex(modules)
+    report_set = None
+    if changed_files is not None:
+        rel_changed = {c.replace(os.sep, "/") for c in changed_files}
+        report_set = index.reverse_dependency_closure(rel_changed)
+    targets = [m for m in modules
+               if report_set is None or m.relpath in report_set]
+    result.files = [m.relpath for m in targets]
+    if report_set is not None:
+        # a changed file that fails to parse is not in the module index
+        # (so not in the closure) but must still fail the gate
+        parse_errors = [f for f in parse_errors
+                        if f.path in report_set or f.path in rel_changed]
+
     # parse errors ride the normal new/baseline pipeline — an
     # unanalyzable file must FAIL the gate, not scan as clean
     raw: List[Finding] = list(parse_errors)
-    for module in modules:
+    audit = audit_suppressions and not rules
+    for module in targets:
         per_file: List[Finding] = []
         for checker in CHECKERS:
             per_file.extend(checker.check(module, index))
@@ -323,6 +353,31 @@ def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
             per_file = [f for f in per_file if f.rule in rules]
         kept, suppressed, meta = _apply_suppressions(module, per_file,
                                                      known)
+        if audit:
+            for s in module.suppressions:
+                if not s.reason:
+                    # reasonless comments already fire
+                    # lint-suppression-reason; don't double-report
+                    continue
+                if "*" in s.rules:
+                    # wildcard: live as long as ANYTHING matched
+                    stale = () if s.used else ("*",)
+                else:
+                    # per RULE: one dead rule in a multi-rule
+                    # suppression is still dead weight (unknown rule
+                    # ids are lint-unknown-rule's job)
+                    stale = tuple(r for r in s.rules
+                                  if r in known and
+                                  r not in s.used_rules)
+                if not stale:
+                    continue
+                meta.append(Finding(
+                    rule="lint-stale-suppression", path=module.relpath,
+                    line=s.comment_line, col=0,
+                    message="suppression of %s no longer matches any "
+                            "finding on line %d — the rule was fixed "
+                            "or the engine got more precise; delete "
+                            "it" % (",".join(stale), s.line)))
         raw.extend(kept)
         raw.extend(meta)          # meta findings are never suppressible
         result.suppressed.extend(suppressed)

@@ -166,6 +166,20 @@ class FunctionInfo:
     def kwonly_names(self) -> List[str]:
         return [p.arg for p in self.node.args.kwonlyargs]
 
+    def default_expr(self, name: str) -> Optional[ast.expr]:
+        """The default-value AST node of parameter ``name`` (positional
+        or keyword-only), or None."""
+        a = self.node.args
+        ps = self.params()
+        if a.defaults:
+            for p, d in zip(ps[len(ps) - len(a.defaults):], a.defaults):
+                if p.arg == name:
+                    return d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if p.arg == name and d is not None:
+                return d
+        return None
+
 
 class CallSite:
     __slots__ = ("module", "scope", "node", "callee")
@@ -201,6 +215,9 @@ class PackageIndex:
         self.methods: Dict[Tuple[str, str, str], FunctionInfo] = {}
         self.classes: Dict[Tuple[str, str], _ClassInfo] = {}
         self.imports: Dict[str, Dict[str, str]] = {}
+        # per-module absolute dotted import candidates (module-dep graph
+        # feeding the --changed reverse-dependency closure)
+        self._import_targets: Dict[str, Set[str]] = {}
         # direct named children per function node (nested-def lookup)
         self._children: Dict[int, Dict[str, FunctionInfo]] = {}
         for m in modules:
@@ -226,10 +243,31 @@ class PackageIndex:
     # -- collection -----------------------------------------------------
     def _collect(self, module):
         imports: Dict[str, str] = {}
+        targets: Set[str] = set()
+        pkg = module.relpath.rsplit("/", 1)[0].split("/") \
+            if "/" in module.relpath else []
 
         def walk(node, parent_fn, cls_name, prefix):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, (ast.Import, ast.ImportFrom)):
+                    if isinstance(child, ast.Import):
+                        for alias in child.names:
+                            targets.add(alias.name)
+                    else:
+                        # resolve relative levels against this module's
+                        # package: level=1 -> same package, level=2 ->
+                        # parent, ...; each imported name may itself be
+                        # a submodule (`from . import telemetry`)
+                        base = pkg[:len(pkg) - (child.level - 1)] \
+                            if child.level else []
+                        parts = base + (child.module.split(".")
+                                        if child.module else [])
+                        mod = ".".join(parts)
+                        if mod:
+                            targets.add(mod)
+                        for alias in child.names:
+                            if mod and alias.name != "*":
+                                targets.add(mod + "." + alias.name)
                     for alias in child.names:
                         local = alias.asname or alias.name.split(".")[0]
                         imports[local] = alias.name
@@ -260,6 +298,7 @@ class PackageIndex:
 
         walk(module.tree, None, None, "")
         self.imports[module.relpath] = imports
+        self._import_targets[module.relpath] = targets
 
     def _register_fn(self, fi: FunctionInfo):
         self.functions.append(fi)
@@ -661,12 +700,80 @@ class PackageIndex:
         self._taint_cache[key] = t
         return t
 
+    # -- module-dependency graph (--changed closure) --------------------
+    @staticmethod
+    def module_dotted(relpath: str) -> str:
+        """Dotted module name of a repo-relative path
+        ('mxnet_tpu/parallel/mesh.py' -> 'mxnet_tpu.parallel.mesh';
+        a package __init__ maps to the package name)."""
+        p = relpath[:-3] if relpath.endswith(".py") else relpath
+        parts = p.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def module_deps(self) -> Dict[str, Set[str]]:
+        """relpath -> set of relpaths (within the scanned set) it
+        imports, resolved through relative levels and
+        `from pkg import submodule` forms."""
+        by_name = {self.module_dotted(m.relpath): m.relpath
+                   for m in self.modules}
+        deps: Dict[str, Set[str]] = {}
+        for m in self.modules:
+            out: Set[str] = set()
+            for cand in self._import_targets.get(m.relpath, ()):
+                hit = by_name.get(cand)
+                if hit is not None and hit != m.relpath:
+                    out.add(hit)
+            deps[m.relpath] = out
+        return deps
+
+    def reverse_dependency_closure(self, changed) -> Set[str]:
+        """relpaths that transitively import any of ``changed``
+        (changed files themselves included) — the set whose findings can
+        move when ``changed`` moves."""
+        deps = self.module_deps()
+        rev: Dict[str, Set[str]] = {}
+        for src, outs in deps.items():
+            for dst in outs:
+                rev.setdefault(dst, set()).add(src)
+        known = {m.relpath for m in self.modules}
+        todo = deque(c for c in changed if c in known)
+        seen: Set[str] = set(todo)
+        while todo:
+            cur = todo.popleft()
+            for imp in rev.get(cur, ()):
+                if imp not in seen:
+                    seen.add(imp)
+                    todo.append(imp)
+        return seen
+
     # -- queries --------------------------------------------------------
     def function_at(self, node) -> Optional[FunctionInfo]:
         return self.by_node.get(id(node))
 
     def functions_in(self, module) -> List[FunctionInfo]:
-        return [fi for fi in self.functions if fi.module is module]
+        # cached: every checker iterates per module, and a linear scan
+        # of the whole function table per (checker, module) pair is the
+        # dominant cost of a full-package run
+        cache = getattr(self, "_fns_by_module", None)
+        if cache is None:
+            cache = {}
+            for fi in self.functions:
+                cache.setdefault(id(fi.module), []).append(fi)
+            self._fns_by_module = cache
+        return cache.get(id(module), [])
+
+    def calls_in(self, module) -> List[CallSite]:
+        """All call sites lexically in ``module`` (cached, source
+        order)."""
+        cache = getattr(self, "_calls_by_module", None)
+        if cache is None:
+            cache = {}
+            for cs in self.call_sites:
+                cache.setdefault(id(cs.module), []).append(cs)
+            self._calls_by_module = cache
+        return cache.get(id(module), [])
 
     def calls_in_scope(self, fi: FunctionInfo) -> List[CallSite]:
         return self._calls_by_scope.get(id(fi.node), [])

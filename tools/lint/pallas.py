@@ -220,9 +220,8 @@ def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
 
 def check(module: ModuleInfo, index: PackageIndex) -> List[Finding]:
     findings: List[Finding] = []
-    calls = [cs for cs in index.call_sites
-             if cs.module is module
-             and call_target_name(cs.node) == "pallas_call"]
+    calls = [cs for cs in index.calls_in(module)
+             if call_target_name(cs.node) == "pallas_call"]
     if not calls:
         return findings
 

@@ -53,9 +53,7 @@ def _jit_call_name(node: ast.Call) -> Optional[str]:
 
 
 def _check_unhashable_static(module, index, findings):
-    for cs in index.call_sites:
-        if cs.module is not module:
-            continue
+    for cs in index.calls_in(module):
         if _jit_call_name(cs.node) is None or not cs.node.args:
             continue
         # resolve the WRAPPED function (args[0]), not the jit callee

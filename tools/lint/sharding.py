@@ -1,0 +1,913 @@
+"""sharding checker: mesh-axis, collective and scan-carry semantics.
+
+The ``parallel/`` layer's failure modes are silent: a mistyped mesh
+axis or a mismatched ``PartitionSpec`` rank produces wrong numerics or
+a trace error only on a real multi-chip mesh; a collective issued by
+some mesh members and not others (divergent control flow inside a
+``shard_map`` body) is a cross-host hang no CPU test can reproduce; an
+unbalanced ``reduce_scatter_padded``/``all_gather_unpad`` pair corrupts
+the ZeRO flat layout; a scan carry whose sharding constraint differs
+between iteration entry and exit resharded every step (a silent
+recompile/collective per iteration).  GSPMD (arxiv 2105.04663) shows
+sharding programs have a checkable propagation semantics — these rules
+are the reviewable subset of it:
+
+* ``shard-axis-unknown`` — an ``axis_name=``/``PartitionSpec`` axis
+  that does not resolve to an axis declared by the enclosing
+  ``shard_map``'s mesh/specs (or, when those stay symbolic, by any mesh
+  declaration in the scanned package);
+* ``shard-spec-rank`` — a ``PartitionSpec`` with more entries than the
+  statically-known rank of the constrained array;
+* ``shard-collective-pairing`` — a ``reduce_scatter_padded`` whose
+  paired ``all_gather_unpad`` reconstructs a different flat padded size
+  (or runs over a different axis), evaluated with the same constant
+  folder the ``padded_size``/``flatten_pad`` arithmetic uses;
+* ``shard-collective-order`` — collectives issued under control flow
+  that diverges across mesh members (a branch over ``lax.axis_index``/
+  ``process_index``, differing per-branch collective sequences, or
+  ``lax.cond``/``switch`` branches with asymmetric collectives) inside
+  a ``shard_map`` body — the classic multi-host deadlock shape;
+* ``shard-carry-reshard`` — a ``lax.scan`` carry element constrained to
+  two different shardings between iteration entry and exit.
+
+Static HBM estimation (the companion facility this family gates for —
+see docs/PERF.md) lives in :mod:`tools.lint.hbm`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo
+from .hbm import padded_size
+from .jitgraph import (PackageIndex, FunctionInfo, call_target_name,
+                       fold_or_none)
+from .tainting import Divergence
+
+RULES = {
+    "shard-axis-unknown":
+        "axis_name/PartitionSpec axis does not resolve to an axis "
+        "declared by the enclosing mesh/specs (or any mesh in the "
+        "package)",
+    "shard-spec-rank":
+        "PartitionSpec has more entries than the statically-known rank "
+        "of the constrained array",
+    "shard-collective-pairing":
+        "reduce_scatter_padded/all_gather_unpad pair with mismatched "
+        "flat padded sizes or axes (corrupts the ZeRO flat layout)",
+    "shard-collective-order":
+        "collective issued under mesh-member-divergent control flow or "
+        "with per-branch order divergence inside a shard_map body "
+        "(multi-host deadlock shape)",
+    "shard-carry-reshard":
+        "lax.scan carry constrained to different shardings at iteration "
+        "entry vs exit (per-step reshard/recompile hazard)",
+}
+
+SHARD_MAP_NAMES = {"shard_map", "shard_map_compat", "_shard_map", "xmap"}
+_SPEC_NAMES = {"P", "PartitionSpec"}
+_MESH_CTORS = {"Mesh", "device_mesh", "make_mesh"}
+
+# collective -> positional index of its axis operand (an axis_name=
+# keyword always wins); pvary/pcast take a TUPLE of axes
+COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "psum_scatter": 1, "reduce_scatter": 1, "ppermute": 1,
+    "all_to_all": 1, "axis_index": 0, "pbroadcast": 1, "pshuffle": 1,
+    "reduce_scatter_padded": 1, "all_gather_unpad": 2, "pvary": 1,
+    "pcast": 1,
+}
+# the subset that moves data: order across members matters (axis_index
+# and the vma casts are local and cannot hang)
+_ORDERED = set(COLLECTIVES) - {"axis_index", "pvary", "pcast"}
+
+
+# ---------------------------------------------------------------------------
+# shared resolution helpers
+# ---------------------------------------------------------------------------
+
+def _chase_name(index: PackageIndex, module: ModuleInfo,
+                scope: Optional[FunctionInfo], name: str,
+                depth: int = 0) -> Optional[ast.expr]:
+    """The value expression last bound to ``name``: scope chain first
+    (single-target assignments only), then module level."""
+    if depth > 4:
+        return None
+    s = scope
+    while s is not None:
+        for stmt in index.shallow_nodes(s):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == name:
+                return stmt.value
+        s = s.parent
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name:
+            return stmt.value
+    return None
+
+
+def _resolve_symbol(index, module, scope, name) -> Optional[str]:
+    """Resolve a Name used as an axis to a string: a parameter's string
+    default along the scope chain, or a local/module assignment that
+    folds to a string."""
+    s = scope
+    while s is not None:
+        if not isinstance(s.node, ast.Lambda) and \
+                (name in s.param_names() or name in s.kwonly_names()):
+            d = s.default_expr(name)
+            v = fold_or_none(d) if d is not None else None
+            return v if isinstance(v, str) else None
+        s = s.parent
+    bound = _chase_name(index, module, scope, name)
+    if bound is not None:
+        v = fold_or_none(bound)
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def _axis_name_tuple(call: ast.Call) -> Optional[ast.expr]:
+    """The axis-names operand of a Mesh/device_mesh constructor call."""
+    cand = call.args[1] if len(call.args) > 1 else None
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            cand = kw.value
+    return cand
+
+
+def _fold_axis_names(expr: Optional[ast.expr]) -> Optional[Tuple[str, ...]]:
+    v = fold_or_none(expr) if expr is not None else None
+    if isinstance(v, str):
+        return (v,)
+    if isinstance(v, tuple) and v and all(isinstance(x, str) for x in v):
+        return v
+    return None
+
+
+def _mesh_axes(index, module, scope, expr, depth=0
+               ) -> Optional[Tuple[str, ...]]:
+    """Statically-known axis names of a mesh expression, or None."""
+    if expr is None or depth > 3:
+        return None
+    if isinstance(expr, ast.Call) and \
+            call_target_name(expr) in _MESH_CTORS:
+        return _fold_axis_names(_axis_name_tuple(expr))
+    if isinstance(expr, ast.Name):
+        bound = _chase_name(index, module, scope, expr.id)
+        if bound is not None and bound is not expr:
+            return _mesh_axes(index, module, scope, bound, depth + 1)
+    return None
+
+
+def _axis_universe(index: PackageIndex) -> Set[str]:
+    """Every mesh axis the scanned package declares: Mesh/device_mesh
+    axis_names literals, ``axis_names`` membership checks,
+    ``mesh.shape["..."]`` subscripts, and the string defaults of
+    ``axis``/``axis_name``/``axis_names`` parameters (each parallel
+    component's canonical axis)."""
+    cached = getattr(index, "_shard_axis_universe", None)
+    if cached is not None:
+        return cached
+    uni: Set[str] = set()
+
+    def scan(node):
+        if isinstance(node, ast.Compare):
+            ops = [node.left] + list(node.comparators)
+            if any(isinstance(o, ast.Attribute)
+                   and o.attr == "axis_names" for o in ops):
+                for o in ops:
+                    if isinstance(o, ast.Constant) and \
+                            isinstance(o.value, str):
+                        uni.add(o.value)
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Attribute) and \
+                    node.value.attr == "shape" and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                uni.add(node.slice.value)
+
+    # mesh constructors ride the call-site table; membership checks and
+    # shape subscripts ride the cached per-function node lists plus the
+    # module-level statements — no fresh full-tree walk
+    for cs in index.call_sites:
+        if call_target_name(cs.node) in _MESH_CTORS:
+            axes = _fold_axis_names(_axis_name_tuple(cs.node))
+            if axes:
+                uni.update(axes)
+    for fi in index.functions:
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        for node in index.shallow_nodes(fi):
+            scan(node)
+        for n in fi.param_names() + fi.kwonly_names():
+            if n in ("axis", "axis_name", "axis_names"):
+                axes = _fold_axis_names(fi.default_expr(n))
+                if axes:
+                    uni.update(axes)
+    for m in index.modules:
+        # module- and class-level statements, SKIPPING function bodies
+        # (those ride the cached shallow_nodes loop above) but not their
+        # siblings — a declaration after a def must still count
+        todo = list(ast.iter_child_nodes(m.tree))
+        while todo:
+            node = todo.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            scan(node)
+            todo.extend(ast.iter_child_nodes(node))
+    index._shard_axis_universe = uni
+    return uni
+
+
+def _axis_refs(expr: Optional[ast.expr]
+               ) -> List[Tuple[ast.expr, Optional[str], Optional[str]]]:
+    """(node, literal, symbol) triples for every axis mentioned in an
+    axis operand (string, name, or tuple/list of either)."""
+    out: List[Tuple[ast.expr, Optional[str], Optional[str]]] = []
+
+    def one(e):
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append((e, e.value, None))
+        elif isinstance(e, ast.Name):
+            out.append((e, None, e.id))
+        elif isinstance(e, (ast.Tuple, ast.List)):
+            for x in e.elts:
+                one(x)
+
+    if expr is not None:
+        one(expr)
+    return out
+
+
+def _axis_operand(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    idx = COLLECTIVES[call_target_name(call)]
+    if idx < len(call.args):
+        return call.args[idx]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# shard_map sites
+# ---------------------------------------------------------------------------
+
+class _Site:
+    __slots__ = ("call", "scope", "body_fns", "vocab_vals", "vocab_syms",
+                 "mesh_axes", "spec_calls")
+
+    def __init__(self):
+        self.body_fns: List[FunctionInfo] = []
+        self.vocab_vals: Set[str] = set()
+        self.vocab_syms: Set[str] = set()
+        self.mesh_axes: Optional[Tuple[str, ...]] = None
+        self.spec_calls: List[ast.Call] = []
+
+
+def _nested_fns(index: PackageIndex, root: FunctionInfo
+                ) -> List[FunctionInfo]:
+    out = []
+    for fi in index.functions:
+        p = fi
+        while p is not None:
+            if p is root:
+                out.append(fi)
+                break
+            p = p.parent
+    return out
+
+
+def _spec_calls_in(index, module, scope, expr, depth=0) -> List[ast.Call]:
+    """PartitionSpec/P Call nodes inside a spec container expression,
+    chasing Names bound to local containers (``in_specs = (...)``)."""
+    if expr is None or depth > 3:
+        return []
+    if isinstance(expr, ast.Name):
+        bound = _chase_name(index, module, scope, expr.id)
+        if bound is None or bound is expr:
+            return []
+        return _spec_calls_in(index, module, scope, bound, depth + 1)
+    out = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and \
+                call_target_name(node) in _SPEC_NAMES:
+            out.append(node)
+        elif isinstance(node, ast.Name) and node is not expr and \
+                depth < 2:
+            bound = _chase_name(index, module, scope, node.id)
+            if isinstance(bound, ast.Call) and \
+                    call_target_name(bound) in _SPEC_NAMES:
+                out.append(bound)
+    return out
+
+
+def _implicit_decls(index, module, scope, site: _Site):
+    """Axis names the enclosing function already validates against the
+    mesh (``mesh.shape[axis]`` subscripts, ``axis in mesh.axis_names``
+    membership checks) — runtime-checked declarations."""
+    s = scope
+    while s is not None:
+        for node in index.shallow_nodes(s):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Attribute) and \
+                    node.value.attr == "shape":
+                if isinstance(node.slice, ast.Constant) and \
+                        isinstance(node.slice.value, str):
+                    site.vocab_vals.add(node.slice.value)
+                elif isinstance(node.slice, ast.Name):
+                    site.vocab_syms.add(node.slice.id)
+            elif isinstance(node, ast.Compare):
+                ops = [node.left] + list(node.comparators)
+                if any(isinstance(o, ast.Attribute)
+                       and o.attr == "axis_names" for o in ops):
+                    for o in ops:
+                        if isinstance(o, ast.Constant) and \
+                                isinstance(o.value, str):
+                            site.vocab_vals.add(o.value)
+                        elif isinstance(o, ast.Name):
+                            site.vocab_syms.add(o.id)
+        s = s.parent
+
+
+def _shard_map_sites(module: ModuleInfo, index: PackageIndex
+                     ) -> List[_Site]:
+    sites = []
+    for cs in index.calls_in(module):
+        if call_target_name(cs.node) not in SHARD_MAP_NAMES or \
+                not cs.node.args:
+            continue
+        site = _Site()
+        site.call = cs.node
+        site.scope = cs.scope
+        body = index.resolve_call(cs.module, cs.scope, cs.node.args[0])
+        if body is not None:
+            site.body_fns = _nested_fns(index, body)
+        mesh_expr = cs.node.args[1] if len(cs.node.args) > 1 else None
+        spec_exprs = list(cs.node.args[2:])
+        for kw in cs.node.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+            else:
+                spec_exprs.append(kw.value)
+        site.mesh_axes = _mesh_axes(index, module, cs.scope, mesh_expr)
+        if site.mesh_axes:
+            site.vocab_vals.update(site.mesh_axes)
+        for expr in spec_exprs:
+            site.spec_calls.extend(
+                _spec_calls_in(index, module, cs.scope, expr))
+        for spec in site.spec_calls:
+            for _, lit, sym in _axis_refs(ast.Tuple(elts=list(spec.args))):
+                if lit is not None:
+                    site.vocab_vals.add(lit)
+                elif sym is not None:
+                    site.vocab_syms.add(sym)
+                    val = _resolve_symbol(index, module, cs.scope, sym)
+                    if val is not None:
+                        site.vocab_vals.add(val)
+        _implicit_decls(index, module, cs.scope, site)
+        for sym in list(site.vocab_syms):
+            val = _resolve_symbol(index, module, cs.scope, sym)
+            if val is not None:
+                site.vocab_vals.add(val)
+        sites.append(site)
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# rule: shard-axis-unknown
+# ---------------------------------------------------------------------------
+
+def _check_axis_ref(module, index, scope, site, universe, node, lit, sym,
+                    where, findings, reported):
+    if lit is not None:
+        val = lit
+    else:
+        if site is not None and sym in site.vocab_syms:
+            return
+        val = _resolve_symbol(index, module, scope, sym)
+        if val is None:
+            return            # symbolic and untrackable: stay quiet
+    ok = False
+    if site is not None and site.mesh_axes:
+        ok = val in site.mesh_axes
+    elif site is not None and val in site.vocab_vals:
+        ok = True
+    elif universe and val in universe:
+        ok = True
+    elif not universe:
+        ok = True             # nothing declared anywhere: no basis
+    if ok or (id(node), val) in reported:
+        return
+    reported.add((id(node), val))
+    declared = site.mesh_axes if (site is not None and site.mesh_axes) \
+        else tuple(sorted((site.vocab_vals if site is not None
+                           and site.vocab_vals else universe)))
+    findings.append(Finding(
+        "shard-axis-unknown", module.relpath, node.lineno,
+        node.col_offset,
+        "%s references mesh axis %r, not among the declared axes %r"
+        % (where, val, tuple(declared)),
+        scope.qualname if scope else "<module>"))
+
+
+def _check_axes(module, index, sites, universe, findings):
+    reported: Set[Tuple[int, str]] = set()
+    in_site_specs: Set[int] = set()
+    in_site_bodies: Set[int] = set()
+    for site in sites:
+        for spec in site.spec_calls:
+            in_site_specs.add(id(spec))
+            for n, lit, _ in _axis_refs(ast.Tuple(elts=list(spec.args))):
+                if lit is not None and site.mesh_axes and \
+                        lit not in site.mesh_axes:
+                    _check_axis_ref(module, index, site.scope, site,
+                                    universe, n, lit, None,
+                                    "PartitionSpec", findings, reported)
+        for fi in site.body_fns:
+            in_site_bodies.add(id(fi.node))
+            for node in index.shallow_nodes(fi):
+                if isinstance(node, ast.Call) and \
+                        call_target_name(node) in COLLECTIVES:
+                    for n, lit, sym in _axis_refs(_axis_operand(node)):
+                        _check_axis_ref(
+                            module, index, fi, site, universe, n, lit,
+                            sym, "%s()" % call_target_name(node),
+                            findings, reported)
+    # outside any shard_map site: literal axis names in collectives and
+    # PartitionSpecs still have to exist SOMEWHERE in the package
+    for fi in index.functions_in(module):
+        if isinstance(fi.node, ast.Lambda) or id(fi.node) in in_site_bodies:
+            continue
+        for node in index.shallow_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_target_name(node)
+            if name in COLLECTIVES and fi.reachable:
+                for n, lit, _ in _axis_refs(_axis_operand(node)):
+                    if lit is not None:
+                        _check_axis_ref(module, index, fi, None,
+                                        universe, n, lit, None,
+                                        "%s()" % name, findings,
+                                        reported)
+            elif name in _SPEC_NAMES and id(node) not in in_site_specs:
+                for n, lit, _ in _axis_refs(
+                        ast.Tuple(elts=list(node.args))):
+                    if lit is not None:
+                        _check_axis_ref(module, index, fi, None,
+                                        universe, n, lit, None,
+                                        "PartitionSpec", findings,
+                                        reported)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: shard-spec-rank
+# ---------------------------------------------------------------------------
+
+_RANK1_CALLS = {"flatten_pad", "arange", "linspace", "ravel", "flatten"}
+_SHAPED_CTORS = {"zeros", "ones", "full", "empty"}
+
+
+def _expr_rank(index, module, fi, expr, env: Dict[str, int], depth=0
+               ) -> Optional[int]:
+    """Statically-known rank of an array expression (conservative:
+    None when unknown)."""
+    if depth > 3 or expr is None:
+        return None
+    if isinstance(expr, ast.Name):
+        return env.get(expr.id)
+    if not isinstance(expr, ast.Call):
+        return None
+    name = call_target_name(expr)
+    if name in _RANK1_CALLS:
+        return 1
+    if name == "reshape" and expr.args:
+        if len(expr.args) == 1 and isinstance(expr.args[0],
+                                              (ast.Tuple, ast.List)):
+            return len(expr.args[0].elts)
+        return len(expr.args)
+    if name in _SHAPED_CTORS and expr.args:
+        a = expr.args[0]
+        if isinstance(a, (ast.Tuple, ast.List)):
+            return len(a.elts)
+        if isinstance(a, ast.Constant) and isinstance(a.value, int):
+            return 1
+    return None
+
+
+def _local_ranks(index, module, fi) -> Dict[str, int]:
+    env: Dict[str, int] = {}
+    for _ in range(2):
+        for stmt in index.shallow_nodes(fi):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                r = _expr_rank(index, module, fi, stmt.value, env)
+                if r is not None:
+                    env[stmt.targets[0].id] = r
+    return env
+
+
+def _spec_call_of(index, module, scope, expr, depth=0
+                  ) -> Optional[ast.Call]:
+    """The P/PartitionSpec Call a sharding expression boils down to:
+    direct, inside NamedSharding(mesh, spec), or via a Name binding."""
+    if expr is None or depth > 3:
+        return None
+    if isinstance(expr, ast.Call):
+        name = call_target_name(expr)
+        if name in _SPEC_NAMES:
+            return expr
+        if name == "NamedSharding" and len(expr.args) >= 2:
+            return _spec_call_of(index, module, scope, expr.args[1],
+                                 depth + 1)
+    if isinstance(expr, ast.Name):
+        bound = _chase_name(index, module, scope, expr.id)
+        if bound is not None and bound is not expr:
+            return _spec_call_of(index, module, scope, bound, depth + 1)
+    return None
+
+
+def _check_spec_rank(module, index, findings):
+    for fi in index.functions_in(module):
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        env = None
+        for node in index.shallow_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_target_name(node)
+            if name == "with_sharding_constraint" and len(node.args) >= 2:
+                target, sh = node.args[0], node.args[1]
+            elif name == "device_put" and len(node.args) >= 2:
+                target, sh = node.args[0], node.args[1]
+            else:
+                continue
+            spec = _spec_call_of(index, module, fi, sh)
+            if spec is None or not spec.args:
+                continue
+            if env is None:
+                env = _local_ranks(index, module, fi)
+            rank = _expr_rank(index, module, fi, target, env)
+            if rank is not None and len(spec.args) > rank:
+                findings.append(Finding(
+                    "shard-spec-rank", module.relpath, node.lineno,
+                    node.col_offset,
+                    "PartitionSpec has %d entries but the constrained "
+                    "array has rank %d" % (len(spec.args), rank),
+                    fi.qualname))
+
+
+# ---------------------------------------------------------------------------
+# rule: shard-collective-pairing
+# ---------------------------------------------------------------------------
+
+def _local_shapes(index, fi) -> Dict[str, Tuple[int, ...]]:
+    """name -> statically-folded shape for literal array constructors."""
+    env: Dict[str, Tuple[int, ...]] = {}
+    for stmt in index.shallow_nodes(fi):
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)):
+            continue
+        if call_target_name(stmt.value) in _SHAPED_CTORS and \
+                stmt.value.args:
+            v = fold_or_none(stmt.value.args[0])
+            if isinstance(v, int):
+                v = (v,)
+            if isinstance(v, tuple) and all(isinstance(x, int)
+                                            for x in v):
+                env[stmt.targets[0].id] = v
+    return env
+
+
+def _numel(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _fold_env(index, fi) -> Dict[str, object]:
+    env: Dict[str, object] = {}
+    for stmt in index.shallow_nodes(fi):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            v = fold_or_none(stmt.value, env)
+            if v is not None:
+                env[stmt.targets[0].id] = v
+    return env
+
+
+def _rs_axis_size(call: ast.Call, env) -> Optional[int]:
+    cand = call.args[2] if len(call.args) > 2 else None
+    for kw in call.keywords:
+        if kw.arg == "axis_size":
+            cand = kw.value
+    v = fold_or_none(cand, env) if cand is not None else None
+    return int(v) if isinstance(v, int) and v > 0 else None
+
+
+def _axis_key(index, module, scope, call) -> Optional[str]:
+    refs = _axis_refs(_axis_operand(call))
+    if len(refs) != 1:
+        return None
+    _, lit, sym = refs[0]
+    if lit is not None:
+        return lit
+    return _resolve_symbol(index, module, scope, sym) or ("~" + sym)
+
+
+def _check_pairing(module, index, findings):
+    for fi in index.functions_in(module):
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        rs_by_name: Dict[str, ast.Call] = {}
+        pairs: List[Tuple[ast.Call, ast.Call]] = []
+        for node in index.shallow_nodes(fi):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    call_target_name(node.value) == \
+                    "reduce_scatter_padded":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        rs_by_name[t.id] = node.value
+            if isinstance(node, ast.Call) and \
+                    call_target_name(node) == "all_gather_unpad" and \
+                    node.args:
+                src = node.args[0]
+                if isinstance(src, ast.Call) and \
+                        call_target_name(src) == "reduce_scatter_padded":
+                    pairs.append((src, node))
+                elif isinstance(src, ast.Name) and src.id in rs_by_name:
+                    pairs.append((rs_by_name[src.id], node))
+        if not pairs:
+            continue
+        env = _fold_env(index, fi)
+        shapes = _local_shapes(index, fi)
+        for rs, ag in pairs:
+            rs_axis = _axis_key(index, module, fi, rs)
+            ag_axis = _axis_key(index, module, fi, ag)
+            if rs_axis and ag_axis and rs_axis != ag_axis and \
+                    not (rs_axis.startswith("~") or
+                         ag_axis.startswith("~")):
+                findings.append(Finding(
+                    "shard-collective-pairing", module.relpath,
+                    ag.lineno, ag.col_offset,
+                    "all_gather_unpad over axis %r paired with a "
+                    "reduce_scatter_padded over axis %r" % (ag_axis,
+                                                            rs_axis),
+                    fi.qualname))
+                continue
+            n = _rs_axis_size(rs, env)
+            if n is None:
+                continue
+            in_shape = None
+            if rs.args:
+                a = rs.args[0]
+                if isinstance(a, ast.Name):
+                    in_shape = shapes.get(a.id)
+                else:
+                    v = fold_or_none(a, env)
+                    if isinstance(v, tuple):
+                        in_shape = v
+            out_shape = fold_or_none(ag.args[1], env) \
+                if len(ag.args) > 1 else None
+            if isinstance(out_shape, int):
+                out_shape = (out_shape,)
+            if in_shape is None or not isinstance(out_shape, tuple):
+                continue
+            pad_in = padded_size(_numel(in_shape), n)
+            pad_out = padded_size(_numel(out_shape), n)
+            if pad_in != pad_out:
+                findings.append(Finding(
+                    "shard-collective-pairing", module.relpath,
+                    ag.lineno, ag.col_offset,
+                    "flat padded size mismatch: reduce_scatter_padded "
+                    "moves %d elements but all_gather_unpad "
+                    "reconstructs %d (shape %r, axis_size %d)"
+                    % (pad_in, pad_out, tuple(out_shape), n),
+                    fi.qualname))
+
+
+# ---------------------------------------------------------------------------
+# rule: shard-collective-order
+# ---------------------------------------------------------------------------
+
+def _branch_seq(stmts: Sequence[ast.stmt]) -> List[Tuple[str, str]]:
+    """Ordered (collective, raw-axis-text) sequence in a branch, not
+    descending into nested function definitions."""
+    out: List[Tuple[str, str]] = []
+    todo = list(stmts)
+    while todo:
+        node = todo.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            name = call_target_name(node)
+            if name in _ORDERED:
+                op = _axis_operand(node)
+                key = ast.dump(op) if op is not None else ""
+                out.append((name, key))
+        todo[:0] = list(ast.iter_child_nodes(node))
+    return out
+
+
+def _fn_seq(index, fi) -> List[Tuple[str, str]]:
+    if fi is None or isinstance(fi.node, ast.Lambda):
+        body = [fi.node.body] if fi is not None else []
+        return _branch_seq(body)
+    return _branch_seq(fi.node.body)
+
+
+def _check_order(module, index, sites, findings):
+    seen: Set[int] = set()
+    for site in sites:
+        for fi in site.body_fns:
+            if id(fi.node) in seen:
+                continue
+            seen.add(id(fi.node))
+            div = Divergence(index, fi)
+            for node in index.shallow_nodes(fi):
+                if isinstance(node, ast.If):
+                    sb = _branch_seq(node.body)
+                    se = _branch_seq(node.orelse)
+                    if not (sb or se):
+                        continue
+                    if div.expr(node.test):
+                        findings.append(Finding(
+                            "shard-collective-order", module.relpath,
+                            node.lineno, node.col_offset,
+                            "collective under a branch that diverges "
+                            "across mesh members (axis_index/"
+                            "process_index) — members disagree on "
+                            "whether to issue it: multi-host deadlock",
+                            fi.qualname))
+                    elif sb and se and sb != se:
+                        findings.append(Finding(
+                            "shard-collective-order", module.relpath,
+                            node.lineno, node.col_offset,
+                            "the two branches issue different "
+                            "collective sequences (%s vs %s) — "
+                            "divergent issue order deadlocks the mesh"
+                            % ([c for c, _ in sb], [c for c, _ in se]),
+                            fi.qualname))
+                elif isinstance(node, ast.Call) and \
+                        call_target_name(node) in ("cond", "switch"):
+                    branches: List[FunctionInfo] = []
+                    cand_args = list(node.args)
+                    if call_target_name(node) == "switch" and \
+                            len(node.args) >= 2 and \
+                            isinstance(node.args[1],
+                                       (ast.List, ast.Tuple)):
+                        cand_args = list(node.args[1].elts)
+                    for a in cand_args:
+                        b = index.resolve_call(module, fi, a)
+                        if b is not None:
+                            branches.append(b)
+                    if len(branches) < 2:
+                        continue
+                    seqs = [_fn_seq(index, b) for b in branches]
+                    if any(s != seqs[0] for s in seqs[1:]):
+                        findings.append(Finding(
+                            "shard-collective-order", module.relpath,
+                            node.lineno, node.col_offset,
+                            "lax.%s branches issue different "
+                            "collective sequences — collectives must "
+                            "be unconditional across mesh members"
+                            % call_target_name(node), fi.qualname))
+
+
+# ---------------------------------------------------------------------------
+# rule: shard-carry-reshard
+# ---------------------------------------------------------------------------
+
+def _spec_key(index, module, scope, expr) -> Optional[Tuple]:
+    spec = _spec_call_of(index, module, scope, expr)
+    if spec is None:
+        return None
+    vals = []
+    for a in spec.args:
+        v = fold_or_none(a)
+        if v is None and not (isinstance(a, ast.Constant)
+                              and a.value is None):
+            return None
+        vals.append(v)
+    return tuple(vals)
+
+
+def _wsc_parts(node: ast.Call):
+    if call_target_name(node) == "with_sharding_constraint" and \
+            len(node.args) >= 2:
+        return node.args[0], node.args[1]
+    return None, None
+
+
+def _check_carry(module, index, findings):
+    for cs in index.calls_in(module):
+        if call_target_name(cs.node) != "scan" or not cs.node.args:
+            continue
+        body = index.resolve_call(cs.module, cs.scope, cs.node.args[0])
+        if body is None or isinstance(body.node, ast.Lambda):
+            continue
+        params = body.param_names()
+        if not params:
+            continue
+        carry_param = params[0]
+        # entry names: the carry tuple destructure (`a, b = carry`)
+        entry_names: List[str] = [carry_param]
+        for stmt in index.shallow_nodes(body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Tuple) and \
+                    isinstance(stmt.value, ast.Name) and \
+                    stmt.value.id == carry_param:
+                entry_names = [t.id for t in stmt.targets[0].elts
+                               if isinstance(t, ast.Name)]
+        # specs: direct applications to a name, and name -> spec of the
+        # wsc call whose result it is bound to
+        applied: Dict[str, List[Tuple[Tuple, ast.Call]]] = {}
+        spec_of: Dict[str, Tuple] = {}
+        derived_from: Dict[str, str] = {}
+        for stmt in index.shallow_nodes(body):
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            src, sh = _wsc_parts(stmt.value)
+            if src is None:
+                continue
+            key = _spec_key(index, module, body, sh)
+            if key is None:
+                continue
+            tgt = stmt.targets[0].id
+            spec_of[tgt] = key
+            if isinstance(src, ast.Name):
+                applied.setdefault(src.id, []).append((key, stmt.value))
+                derived_from[tgt] = src.id
+        if not spec_of:
+            continue
+        # exit specs per carry position from `return (c0, c1, ...), y`
+        exit_specs: Dict[int, Tuple] = {}
+        for stmt in index.shallow_nodes(body):
+            if not (isinstance(stmt, ast.Return)
+                    and isinstance(stmt.value, ast.Tuple)
+                    and stmt.value.elts):
+                continue
+            carry_out = stmt.value.elts[0]
+            elts = carry_out.elts if isinstance(carry_out, ast.Tuple) \
+                else [carry_out]
+            for i, el in enumerate(elts):
+                if isinstance(el, ast.Name) and el.id in spec_of:
+                    exit_specs[i] = spec_of[el.id]
+                elif isinstance(el, ast.Call):
+                    _, sh = _wsc_parts(el)
+                    if sh is not None:
+                        key = _spec_key(index, module, body, sh)
+                        if key is not None:
+                            exit_specs[i] = key
+        for i, name in enumerate(entry_names):
+            # entry-to-exit spec chain of carry position i: constraints
+            # applied directly to the entry name (in source order), the
+            # constraints of names derived FROM it via wsc, and the
+            # returned position's spec
+            specs: List[Tuple] = [k for k, _ in applied.get(name, [])]
+            anchors: List[ast.Call] = [c for _, c in applied.get(name, [])]
+            for tgt, src in derived_from.items():
+                if src == name and tgt in spec_of:
+                    specs.append(spec_of[tgt])
+            if i in exit_specs:
+                specs.append(exit_specs[i])
+            distinct: List[Tuple] = []
+            for s in specs:
+                if s not in distinct:
+                    distinct.append(s)
+            if len(distinct) < 2:
+                continue
+            loc = anchors[-1] if anchors else body.node
+            findings.append(Finding(
+                "shard-carry-reshard", module.relpath, loc.lineno,
+                getattr(loc, "col_offset", 0),
+                "scan carry %r is constrained to %r at entry but %r "
+                "at exit — every iteration reshards (hidden collective "
+                "+ recompile pressure)"
+                % (name, distinct[0], distinct[-1]), body.qualname))
+
+
+# ---------------------------------------------------------------------------
+
+def check(module: ModuleInfo, index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = _shard_map_sites(module, index)
+    universe = _axis_universe(index)
+    _check_axes(module, index, sites, universe, findings)
+    _check_spec_rank(module, index, findings)
+    _check_pairing(module, index, findings)
+    _check_order(module, index, sites, findings)
+    _check_carry(module, index, findings)
+    return findings

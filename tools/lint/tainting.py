@@ -224,6 +224,69 @@ class Taint:
             any(self.expr(k.value) for k in node.keywords)
 
 
+# calls whose result differs per mesh member / host process — the seed
+# of the sharding checker's divergent-control-flow analysis
+DIVERGENT_CALLS = {"axis_index", "process_index"}
+DIVERGENT_ATTRS = {"rank", "process_index"}
+
+
+class Divergence:
+    """Names in one function holding per-shard/per-host varying values
+    (derived from ``lax.axis_index``/``jax.process_index``/``.rank``).
+
+    A Python branch over such a value inside a shard_map body executes a
+    DIFFERENT trace per member — collectives under it are issued by some
+    members and not others, the classic multi-host deadlock.  Same
+    flow-insensitive fixpoint shape as :class:`Taint`, but the property
+    tracked is member-divergence, not tracedness: shapes and dtypes of
+    divergent values are NOT divergent, arithmetic over them is.
+    """
+
+    def __init__(self, index, fi):
+        self.index = index
+        self.fi = fi
+        self.divergent: Set[str] = set()
+        nodes = index.shallow_nodes(fi)
+        for _ in range(4):
+            before = len(self.divergent)
+            for node in nodes:
+                self._visit(node)
+            if len(self.divergent) == before:
+                break
+
+    def _visit(self, node):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                             ast.NamedExpr)):
+            value = getattr(node, "value", None)
+            if value is not None and self.expr(value):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.divergent.add(n.id)
+
+    def expr(self, node: Optional[ast.expr]) -> bool:
+        if node is None or isinstance(node, (ast.Constant, ast.Lambda)):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.divergent
+        if isinstance(node, ast.Attribute):
+            if node.attr in DIVERGENT_ATTRS:
+                return True
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            if call_target_name(node) in DIVERGENT_CALLS:
+                return True
+            return any(self.expr(a) for a in node.args) or \
+                any(self.expr(k.value) for k in node.keywords) or \
+                self.expr(node.func)
+        return any(self.expr(v) for v in ast.iter_child_nodes(node)
+                   if isinstance(v, ast.expr))
+
+
 def is_iter_adapter(it: ast.expr) -> bool:
     """True when a for-loop's iterable is Python-level container
     iteration (zip/enumerate/.items()/list literals/comprehensions) —

@@ -81,6 +81,8 @@ def parse_jsonl(lines):
     counters = {}
     gauges = {}
     recompiles = []
+    hbm = {}
+    lint_gate = None
     steps = 0
     for line in lines:
         line = line.strip()
@@ -101,6 +103,16 @@ def parse_jsonl(lines):
             recompiles.append({"name": rec.get("name"),
                                "n": rec.get("n"),
                                "changed": rec.get("changed", [])})
+        elif kind == "hbm":
+            # static per-chip HBM estimate, one per compiled program
+            # (mxnet_tpu.parallel journals these at jit-cache misses);
+            # keyed (program, mode) so the scan and per-call variants of
+            # one step each keep their row
+            key = "%s/%s" % (rec.get("program", "?"),
+                             rec.get("mode", "?"))
+            hbm[key] = rec
+        elif kind == "lint" and rec.get("name") == "gate":
+            lint_gate = rec
         elif kind == "snapshot":
             counters.update(rec.get("counters", {}))
             gauges.update(rec.get("gauges", {}))
@@ -112,7 +124,37 @@ def parse_jsonl(lines):
             if s["count"] else None
         s["total_ms"] = round(s["total_ms"], 4)
     return {"spans": spans, "counters": counters, "gauges": gauges,
-            "recompiles": recompiles, "steps": steps}
+            "recompiles": recompiles, "steps": steps, "hbm": hbm,
+            "lint_gate": lint_gate}
+
+
+def _render_hbm(hbm, fmt="markdown"):
+    """Bytes-per-chip table, one row per compiled program, from the
+    hbm/estimate journal events."""
+    if not hbm:
+        return []
+    header = ["program", "mode", "params-MiB", "state-MiB", "act-MiB",
+              "total-MiB", "shards"]
+    out = ["", "static HBM estimate (bytes/chip per compiled program):"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+
+    def mib(rec, key):
+        v = rec.get(key)
+        return "%.4g" % (float(v) / 1048576.0) if v is not None else "-"
+
+    for key in sorted(hbm):
+        r = hbm[key]
+        vals = [str(r.get("program", "?")), str(r.get("mode", "?")),
+                mib(r, "params_bytes_per_chip"),
+                mib(r, "opt_state_bytes_per_chip"),
+                mib(r, "activation_bytes_per_chip"),
+                mib(r, "total_bytes_per_chip"),
+                str(r.get("n_shards", "-"))]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
 
 
 def render_jsonl(agg, fmt="markdown"):
@@ -139,29 +181,59 @@ def render_jsonl(agg, fmt="markdown"):
         for r in agg["recompiles"]:
             out.append("  %s (#%s): %s" % (r["name"], r["n"],
                                            "; ".join(r["changed"])))
+    out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
+
+
+# rule-id prefix -> checker family (docs/LINTING.md catalog sections)
+_RULE_FAMILIES = {"trace": "trace-safety", "retrace": "retrace",
+                  "donate": "donation", "pallas": "pallas",
+                  "shard": "sharding", "lint": "meta"}
+
+
+def _rule_family(rule):
+    return _RULE_FAMILIES.get(rule.split("-", 1)[0], "other")
 
 
 def parse_lint(text):
     """Parse a graftlint ``--format json`` report into
     ``{"counts": {...}, "by_rule": {rule: n}, "by_file": {path: n},
-    "findings": [...]}`` (new findings only; baselined/suppressed are
-    reflected in counts)."""
-    data = json.loads(text)
+    "findings": [...], "hbm": {...}}`` (new findings only;
+    baselined/suppressed are reflected in counts).
+
+    Also accepts a telemetry JSONL sink instead of a report: the
+    ``lint/gate`` event supplies the counts and the ``hbm/estimate``
+    events the bytes-per-chip table (one file carries both when the
+    tier-1 gate and a training run share a journal)."""
+    data = None
+    try:
+        data = json.loads(text)
+    except ValueError:
+        pass
+    if not isinstance(data, dict):
+        agg = parse_jsonl(text.splitlines())
+        gate = agg.get("lint_gate") or {}
+        counts = {k: gate.get(k, 0)
+                  for k in ("new", "baselined", "suppressed")}
+        counts["total"] = sum(counts.values())
+        return {"counts": counts, "by_rule": {}, "by_file": {},
+                "findings": [], "hbm": agg.get("hbm") or {}}
     by_rule = {}
     by_file = {}
     for f in data.get("findings", []):
         by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
         by_file[f["path"]] = by_file.get(f["path"], 0) + 1
     return {"counts": data.get("counts", {}), "by_rule": by_rule,
-            "by_file": by_file, "findings": data.get("findings", [])}
+            "by_file": by_file, "findings": data.get("findings", []),
+            "hbm": data.get("hbm_estimates", {})}
 
 
 def render_lint(agg, fmt="markdown"):
-    """Summary table (new/baselined/suppressed + per-rule counts), then
-    one line per new finding."""
+    """Summary table (new/baselined/suppressed + per-family/rule
+    counts), one line per new finding, and the static-HBM table when
+    the input journal carried hbm/estimate events."""
     c = agg["counts"]
-    header = ["rule", "new"]
+    header = ["family", "rule", "new"]
     out = []
     if fmt == "markdown":
         out.append("lint: %d new, %d baselined, %d suppressed (%d total)"
@@ -174,8 +246,9 @@ def render_lint(agg, fmt="markdown"):
         out.append("new\t%d" % c.get("new", 0))
         out.append("baselined\t%d" % c.get("baselined", 0))
         out.append("suppressed\t%d" % c.get("suppressed", 0))
-    for rule in sorted(agg["by_rule"]):
-        vals = [rule, str(agg["by_rule"][rule])]
+    for rule in sorted(agg["by_rule"],
+                       key=lambda r: (_rule_family(r), r)):
+        vals = [_rule_family(rule), rule, str(agg["by_rule"][rule])]
         out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
                    else "\t".join(vals))
     if agg["findings"]:
@@ -184,6 +257,7 @@ def render_lint(agg, fmt="markdown"):
             out.append("%s:%d: %s [%s] (in %s)"
                        % (f["path"], f["line"], f["message"], f["rule"],
                           f.get("context", "?")))
+    out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
 
 
