@@ -106,6 +106,13 @@ class DevicePrefetchIter(DataIter):
         self._reuse_host = self._device.platform != "cpu"
         self._q = None
         self._stop = threading.Event()
+        # serializes feeder lifecycle transitions.  Reentrant, held
+        # across the WHOLE stop->start pair in reset()/close(): two
+        # racing resets interleaving as stop,stop,start,start would
+        # otherwise orphan a live feeder on the shared ring.  Feeder
+        # and consumers never take it on the hot path, so holding it
+        # over the (drain-bounded) join cannot deadlock them.
+        self._lifecycle = threading.RLock()
         self._thread = None
         self._exhausted = False
         # GC safety net: a dropped iterator must not leave a feeder
@@ -317,15 +324,16 @@ class DevicePrefetchIter(DataIter):
                 return
 
     def _start_feeder(self):
-        self._q = queue.Queue(maxsize=self._depth)
-        self._stop.clear()
-        self._exhausted = False
-        self._thread = threading.Thread(
-            target=DevicePrefetchIter._feed,
-            args=(weakref.ref(self), self._q, self._stop),
-            name="DevicePrefetchIter-feeder", daemon=True)
-        self._holder["thread"] = self._thread
-        self._thread.start()
+        with self._lifecycle:
+            self._q = queue.Queue(maxsize=self._depth)
+            self._stop.clear()
+            self._exhausted = False
+            self._thread = threading.Thread(
+                target=DevicePrefetchIter._feed,
+                args=(weakref.ref(self), self._q, self._stop),
+                name="DevicePrefetchIter-feeder", daemon=True)
+            self._holder["thread"] = self._thread
+            self._thread.start()
 
     @staticmethod
     def _shutdown_thread(stop, holder):
@@ -335,18 +343,47 @@ class DevicePrefetchIter(DataIter):
             t.join(timeout=5.0)
 
     def _stop_feeder(self):
-        self._stop.set()
-        t = self._thread
-        if t is not None and t is not threading.current_thread():
-            while t.is_alive():
-                try:                        # unblock a feeder stuck in put
-                    self._q.get_nowait()
-                except queue.Empty:
-                    pass
-                t.join(timeout=0.05)
-        self._thread = None
-        self._holder["thread"] = None
-        self._q = None
+        # the join stays INSIDE the transition lock: reset() must not
+        # be able to start a successor feeder while the old one is
+        # still unwinding (a second concurrent stop sees None and
+        # skips)
+        with self._lifecycle:
+            self._stop.set()
+            t, q = self._thread, self._q
+            self._thread = None
+            self._holder["thread"] = None
+            self._q = None
+            if t is not None and t is not threading.current_thread():
+                while t.is_alive():
+                    try:                    # unblock a feeder stuck in put
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+                    # graftlint: disable-next=conc-blocking-under-lock --
+                    # the transition mutex must span stop->join->restart
+                    # (interleaved stop,stop,start,start would orphan a
+                    # feeder); feeder and consumer hot paths never take
+                    # it, and the drain above bounds the join to one
+                    # in-flight decode
+                    t.join(timeout=0.05)
+            if q is not None:
+                # wake any consumer still blocked in next()'s q.get() —
+                # the feeder is dead and will never put again; consumers
+                # chain the sentinel onward (see next()) so every
+                # waiter unblocks.  The sentinel MUST land: a full queue
+                # can still have blocked consumers racing for its items
+                # (feeder's final put vs the drain), so on Full we
+                # discard a stale item and retry — only consumers pop
+                # concurrently, which helps, so this terminates
+                while True:
+                    try:
+                        q.put_nowait((_END, None))
+                        break
+                    except queue.Full:
+                        try:
+                            q.get_nowait()
+                        except queue.Empty:
+                            pass
 
     # ------------------------------------------------------------------
     # DataIter surface
@@ -369,41 +406,66 @@ class DevicePrefetchIter(DataIter):
         return self._base.provide_label
 
     def reset(self):
-        self._stop_feeder()
-        self._base.reset()
-        self._start_feeder()
+        # one atomic stop->start transition: a racing reset()/close()
+        # serializes behind the whole pair instead of interleaving
+        with self._lifecycle:
+            self._stop_feeder()
+            self._base.reset()
+            self._start_feeder()
 
     def next(self):
-        if self._exhausted or self._q is None:
+        # snapshot the queue ONCE: a concurrent close()/reset() nulls
+        # self._q, and re-reading it after the liveness check would turn
+        # that race into an AttributeError (or a get() on a fresh
+        # post-reset queue)
+        q = self._q
+        if self._exhausted or q is None:
             raise StopIteration
         # ring occupancy BEFORE the blocking get: 0 here means the
         # consumer is about to stall on the pipeline (the "stalled
         # prefetch ring" signature); depth alongside so occupancy reads
         # as a fraction
-        telemetry.gauge("prefetch.ring_occupancy", self._q.qsize())
+        telemetry.gauge("prefetch.ring_occupancy", q.qsize())
         telemetry.gauge("prefetch.ring_depth", self._depth)
         t0 = time.perf_counter()
-        kind, payload = self._q.get()
+        kind, payload = q.get()
         telemetry.observe("prefetch.consumer_wait",
                           time.perf_counter() - t0)
         if kind == _BATCH:
             telemetry.inc("prefetch.batches")
-        if kind == _END:
-            self._exhausted = True
+        if kind in (_END, _ERR):
+            # a sentinel from a SUPERSEDED queue (this consumer lost a
+            # race against reset()) ends only this call — it must not
+            # mark the freshly-started epoch exhausted.  Check-and-set
+            # under the transition lock: an unlocked check could pass
+            # just before reset() swaps the queue and then poison the
+            # new epoch
+            with self._lifecycle:
+                if q is self._q:
+                    self._exhausted = True
+            # chain a sentinel to the next blocked consumer (N threads
+            # may wait on one ring; the feeder/stop/error paths put
+            # only ONE); a full queue means nobody is blocked.  Errors
+            # chain _END: one consumer surfaces the exception, the
+            # rest see a clean end-of-stream
+            try:
+                q.put_nowait((_END, None))
+            except queue.Full:
+                pass
+            if kind == _ERR:
+                raise payload
             raise StopIteration
-        if kind == _ERR:
-            self._exhausted = True
-            raise payload
         from ..ndarray.ndarray import _wrap
         dev, dev_lab, pad = payload
         return DataBatch([_wrap(dev)], [_wrap(dev_lab)], pad=pad)
 
     def close(self):
-        self._stop_feeder()
-        self._finalizer.detach()
-        close = getattr(self._base, "close", None)
-        if close:
-            close()
+        with self._lifecycle:
+            self._stop_feeder()
+            self._finalizer.detach()
+            close = getattr(self._base, "close", None)
+            if close:
+                close()
 
     def __del__(self):
         try:

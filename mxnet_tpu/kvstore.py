@@ -265,6 +265,14 @@ class KVStoreTPU(KVStoreLocal):
         super().__init__(type_str)
         _start_liveness_heartbeat()
 
+    def close(self):
+        """Stop this process's liveness heartbeat publisher (the
+        process-wide analogue of the reference's ``Finalize`` teardown,
+        ps-lite van shutdown).  Idempotent; also runs via ``atexit`` so
+        a dropped store cannot leave the daemon publishing "alive" into
+        a coordinator that is shutting down."""
+        _stop_liveness_heartbeat()
+
     def _supports_compression(self):
         # reference: only device/dist stores compress (kvstore.py:423)
         return True
@@ -421,7 +429,7 @@ import functools
 # ---------------------------------------------------------------------------
 
 _HB_KEY = "mxtpu/hb/%d"
-_hb_state = {"thread": None}
+_hb_state = {"thread": None, "stop": None}
 
 
 def _hb_window() -> float:
@@ -432,7 +440,12 @@ def _hb_window() -> float:
 def _start_liveness_heartbeat():
     """Start this process's heartbeat publisher (idempotent; only on
     multi-process runs whose coordination client lacks a native liveness
-    view — with ``get_live_nodes`` the service tracks liveness itself)."""
+    view — with ``get_live_nodes`` the service tracks liveness itself).
+    The publisher is paired with a stop Event + ``join`` in
+    :func:`_stop_liveness_heartbeat`, reachable from
+    ``KVStoreTPU.close()`` and registered with ``atexit`` — a daemon
+    thread must not publish "I am alive" into the coordinator while the
+    interpreter is tearing down."""
     import jax
     if jax.process_count() <= 1 or _hb_state["thread"] is not None:
         return
@@ -440,19 +453,21 @@ def _start_liveness_heartbeat():
     client = getattr(_dist.global_state, "client", None)
     if client is None or hasattr(client, "get_live_nodes"):
         return
+    import atexit
     import threading
     import time as _time
     rank = jax.process_index()
     interval = max(0.5, _hb_window() / 4.0)
+    stop = threading.Event()
 
     def beat():
         # a transient coordinator error (RPC deadline while it serves a
         # barrier) must NOT kill the publisher — a dead publisher makes
         # every peer count this LIVE worker as dead.  Only give up
         # after several consecutive failures (coordinator really gone,
-        # e.g. shutdown).
+        # e.g. shutdown), or when the owner signals shutdown.
         misses = 0
-        while misses < 5:
+        while misses < 5 and not stop.is_set():
             try:
                 try:
                     client.key_value_set(_HB_KEY % rank,
@@ -471,11 +486,31 @@ def _start_liveness_heartbeat():
                 misses = 0
             except Exception:
                 misses += 1
-            _time.sleep(interval)
+            # Event.wait, not time.sleep: shutdown interrupts the
+            # inter-beat pause instead of waiting out the interval
+            stop.wait(interval)
 
     t = threading.Thread(target=beat, name="mxtpu-heartbeat", daemon=True)
-    t.start()
+    _hb_state["stop"] = stop
     _hb_state["thread"] = t
+    t.start()
+    if not _hb_state.get("atexit"):
+        # register ONCE — restart cycles must not accumulate handlers
+        _hb_state["atexit"] = True
+        atexit.register(_stop_liveness_heartbeat)
+
+
+def _stop_liveness_heartbeat():
+    """Signal and join this process's heartbeat publisher (idempotent;
+    a later ``KVStoreTPU`` may start a fresh one)."""
+    t = _hb_state.get("thread")
+    stop = _hb_state.get("stop")
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=5.0)
+    _hb_state["thread"] = None
+    _hb_state["stop"] = None
 
 
 def _heartbeat_dead_count(client, ids, timeout) -> int:

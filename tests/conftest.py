@@ -40,3 +40,17 @@ def _seed_rng():
     mx.random.seed(seed)
     onp.random.seed(seed)
     yield
+
+
+@pytest.fixture(scope="session")
+def package_lock_graph():
+    """ONE static lock graph over mxnet_tpu/ shared by every runtime
+    lock-order cross-check (tests/test_concurrency_stress.py,
+    tests/test_runtime_lockorder.py) — the build costs a full
+    PackageIndex (~3 s), so per-file fixtures would pay it repeatedly."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.lint.concurrency import static_lock_graph
+    return static_lock_graph([os.path.join(repo, "mxnet_tpu")])

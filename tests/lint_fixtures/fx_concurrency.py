@@ -1,0 +1,343 @@
+"""concurrency fixture: seeded host-threading violations.
+
+Each violation line carries an expect-rule marker asserted exactly by
+tests/test_lint.py.  The clean twins next to each seeded bug pin the
+checker's precision: lock-guarded accesses on both sides, the
+Event-guarded stop flag, the bounded ``deque(maxlen=...)`` journal,
+the lock-then-copy snapshot, consistent lock orders, RLock
+self-reentry, nonblocking queue probes and while-looped Condition
+waits must all stay silent.
+"""
+import queue
+import threading
+import time
+from collections import deque
+from functools import partial
+
+
+# -- unguarded shared write (attr written on the thread, read on main) -------
+
+class UnguardedCounter:
+    def __init__(self):
+        self.count = 0
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._work, daemon=True)
+        self.thread.start()
+
+    def _work(self):
+        while not self._stop.is_set():
+            self.count = self.count + 1  # expect: conc-unguarded-shared-write
+
+    def read(self):
+        return self.count
+
+    def close(self):
+        self._stop.set()
+        self.thread.join()
+
+
+class GuardedCounter:
+    """Clean twin: the same shape with one lock held on BOTH sides."""
+
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._work, daemon=True)
+        self.thread.start()
+
+    def _work(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.count += 1
+
+    def read(self):
+        with self._lock:
+            return self.count
+
+    def close(self):
+        self._stop.set()
+        self.thread.join()
+
+
+class FlagWorker:
+    """Clean twins: Event-guarded stop flag, bounded deque journal and
+    an immutable-constant rebind are all atomic by design."""
+
+    def __init__(self):
+        self._done = False
+        self._stop = threading.Event()
+        self.results = deque(maxlen=16)
+        self.thread = threading.Thread(target=self._work, daemon=True)
+        self.thread.start()
+
+    def _work(self):
+        while not self._stop.is_set():
+            self.results.append(1)      # deque(maxlen=...): clean
+        self._done = True               # immutable rebind: clean
+
+    def poll(self):
+        return self._done and len(self.results)
+
+    def close(self):
+        self._stop.set()
+        self.thread.join()
+
+
+class SnapshotJournal:
+    """Clean twin: lock-then-copy snapshot — a plain list mutated on
+    the thread and copied out on main, one lock on both sides."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._work, daemon=True)
+        self.thread.start()
+
+    def _work(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._events.append("tick")
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._events)
+
+    def close(self):
+        self._stop.set()
+        self.thread.join()
+
+
+class PartialTarget:
+    """Thread entry through functools.partial over a bound-class
+    method — the unguarded write must still be discovered."""
+
+    def __init__(self):
+        self.value = 0
+        self._stop = threading.Event()
+        self.thread = threading.Thread(
+            target=partial(PartialTarget._loop, self), daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.value = self.value + 1  # expect: conc-unguarded-shared-write
+
+    def read(self):
+        return self.value
+
+    def close(self):
+        self._stop.set()
+        self.thread.join()
+
+
+# -- module-global written by a publisher thread -----------------------------
+
+_journal = []
+_hb = {"thread": None, "stop": None}
+
+
+def _publisher(stop):
+    while not stop.is_set():
+        _journal.append("beat")  # expect: conc-unguarded-shared-write
+
+
+def read_journal():
+    return list(_journal)
+
+
+def start_publisher():
+    stop = threading.Event()
+    t = threading.Thread(target=_publisher, args=(stop,), daemon=True)
+    _hb["thread"] = t
+    _hb["stop"] = stop
+    t.start()
+
+
+def shutdown():
+    stop = _hb.get("stop")
+    if stop is not None:
+        stop.set()
+    t = _hb.get("thread")
+    if t is not None:
+        t.join()
+
+
+# -- lock-order cycles -------------------------------------------------------
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def transfer_ab():
+    with _lock_a:
+        with _lock_b:  # expect: conc-lock-order
+            return 1
+
+
+def transfer_ba():
+    with _lock_b:
+        with _lock_a:  # expect: conc-lock-order
+            return 2
+
+
+_lock_c = threading.Lock()
+_lock_d = threading.Lock()
+
+
+def consistent_cd_1():
+    with _lock_c:
+        with _lock_d:
+            return 1
+
+
+def consistent_cd_2():
+    with _lock_c:
+        with _lock_d:
+            return 2
+
+
+_lock_e = threading.Lock()
+_lock_f = threading.Lock()
+
+
+def _grab_f():
+    with _lock_f:  # expect: conc-lock-order
+        return 1
+
+
+def hold_e_then_f():
+    # interprocedural half of the inversion: e is held at _grab_f's
+    # only call site, so its acquisition of f is an e -> f edge
+    with _lock_e:
+        return _grab_f()
+
+
+def hold_f_then_e():
+    with _lock_f:
+        with _lock_e:  # expect: conc-lock-order
+            return 2
+
+
+_lock_g = threading.Lock()
+_rlock = threading.RLock()
+
+
+def reenter_same_lock():
+    with _lock_g:
+        with _lock_g:  # expect: conc-lock-order
+            return 1
+
+
+def reenter_rlock_is_clean():
+    with _rlock:
+        with _rlock:
+            return 1
+
+
+# -- blocking while a lock is held -------------------------------------------
+
+_q = queue.Queue(maxsize=4)
+_bl = threading.Lock()
+_ev = threading.Event()
+
+
+def sleep_under_lock():
+    with _bl:
+        time.sleep(0.1)  # expect: conc-blocking-under-lock
+
+
+def queue_get_under_lock():
+    with _bl:
+        return _q.get()  # expect: conc-blocking-under-lock
+
+
+def wait_under_lock():
+    with _bl:
+        _ev.wait()  # expect: conc-blocking-under-lock
+
+
+def _wait_for_item():
+    # the lock is held at this helper's only call site (below) — the
+    # must-held-at-entry pass carries it in
+    return _q.get()  # expect: conc-blocking-under-lock
+
+
+def locked_fetch():
+    with _bl:
+        return _wait_for_item()
+
+
+def nonblocking_under_lock_is_clean():
+    with _bl:
+        try:
+            return _q.get_nowait()
+        except queue.Empty:
+            return None
+
+
+def sleep_outside_lock_is_clean():
+    with _bl:
+        x = 1
+    time.sleep(0.0)
+    return x
+
+
+# -- thread lifecycle --------------------------------------------------------
+
+def leak_thread():
+    t = threading.Thread(target=_publisher,  # expect: conc-thread-lifecycle
+                         args=(threading.Event(),), daemon=True)
+    t.start()
+
+
+class JoinButNoStop:
+    def __init__(self):
+        self.thread = threading.Thread(target=self._spin,  # expect: conc-thread-lifecycle
+                                       daemon=True)
+        self.thread.start()
+
+    def _spin(self):
+        while True:
+            time.sleep(0.01)
+
+    def close(self):
+        self.thread.join(0.1)
+
+
+class StoppableWorker:
+    """Clean twin: stop Event set + join on the close path."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._spin, daemon=True)
+        self.thread.start()
+
+    def _spin(self):
+        while not self._stop.is_set():
+            time.sleep(0.01)
+
+    def close(self):
+        self._stop.set()
+        self.thread.join()
+
+
+# -- Condition.wait discipline -----------------------------------------------
+
+_cond = threading.Condition()
+_items = []
+
+
+def wait_unlooped():
+    with _cond:
+        if not _items:
+            _cond.wait()  # expect: conc-condition-wait-unlooped
+        return _items.pop()
+
+
+def wait_looped_is_clean():
+    with _cond:
+        while not _items:
+            _cond.wait()
+        return _items.pop()

@@ -1,0 +1,23 @@
+"""Pristine two-lock module shared by the seeded-inversion acceptance
+tests: every path takes ``_a`` before ``_b``, so the static checker
+(tests/test_lint.py) and the runtime sanitizer
+(tests/test_runtime_lockorder.py) both see a clean, consistent order.
+Each test reads this file's SOURCE, writes it to a tmp module, and
+seeds the ABBA bug by inverting pop()'s with-pair via text replace —
+one fixture, two detector halves, identical line numbers."""
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def push():
+    with _a:
+        with _b:
+            return 1
+
+
+def pop():
+    with _a:
+        with _b:
+            return 2

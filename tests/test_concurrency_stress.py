@@ -1,0 +1,276 @@
+"""Concurrency stress: the threaded host layer under the runtime
+lock-order sanitizer.
+
+Drives the real hazards this PR's concurrency rules model: N consumer
+threads against one ``DevicePrefetchIter`` with a racing ``close()``
+(the shape the prefetcher lifecycle-lock + END-sentinel fix hardens),
+and the KV heartbeat publisher flapping through a failing coordinator.
+Every scenario runs inside ``LockOrderSanitizer`` and must satisfy the
+static-vs-runtime contract: the observed acquisition-order graph is a
+subgraph of ``tools.lint.concurrency.static_lock_graph(mxnet_tpu/)``
+and contains no cycle.
+
+The tier-1 variant uses 2 consumers and a deterministic close point;
+the ``slow``-marked variant randomizes depth, consumer count and close
+timing across rounds.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io.device_prefetch import DevicePrefetchIter
+from mxnet_tpu.io.io import DataDesc, DataIter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.lint.runtime_lockorder import LockOrderSanitizer  # noqa: E402
+
+# package_lock_graph: session-scoped fixture from tests/conftest.py
+
+
+class HostIter(DataIter):
+    """Minimal host-side base: ``next_host`` batches with an optional
+    per-batch delay so consumers can be forced to block on the ring."""
+
+    def __init__(self, n=16, delay=0.0, batch=4):
+        super().__init__(batch)
+        self.n, self.delay, self.i = n, delay, 0
+        self._batch = batch
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self._batch, 3, 2, 2))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self._batch,))]
+
+    def reset(self):
+        self.i = 0
+
+    def next_host(self):
+        if self.delay:
+            time.sleep(self.delay)
+        if self.i >= self.n:
+            raise StopIteration
+        self.i += 1
+        data = onp.full((self._batch, 3, 2, 2), self.i, "float32")
+        label = onp.zeros((self._batch,), "float32")
+        return data, label, 0
+
+
+def _consume(it, got, errs):
+    try:
+        while True:
+            got.append(it.next())
+    except StopIteration:
+        pass
+    except Exception as e:        # noqa: BLE001 - the assertion payload
+        errs.append(e)
+
+
+def _run_consumers(it, n_threads, close_after_s, join_timeout=20.0):
+    got, errs = [], []
+    threads = [threading.Thread(target=_consume, args=(it, got, errs),
+                                name="consumer-%d" % i)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    time.sleep(close_after_s)
+    it.close()
+    for t in threads:
+        t.join(timeout=join_timeout)
+    hung = [t.name for t in threads if t.is_alive()]
+    return got, errs, hung
+
+
+def test_prefetch_concurrent_consume_close_deterministic(
+        package_lock_graph):
+    """tier-1: 2 consumers, one mid-stream close.  No consumer may
+    hang (the END sentinel chains through all blocked waiters), no
+    consumer may crash (the queue snapshot in next() beats the
+    lifecycle transition), and the observed lock graph must honor the
+    static contract."""
+    with LockOrderSanitizer() as san:
+        it = DevicePrefetchIter(HostIter(n=64, delay=0.01),
+                                dtype="float32", depth=2)
+        got, errs, hung = _run_consumers(it, n_threads=2,
+                                         close_after_s=0.08)
+        # a second close is idempotent
+        it.close()
+    assert not hung, "consumers hung across close(): %s" % hung
+    assert not errs, errs
+    assert got, "consumers never saw a batch before close"
+    san.assert_no_cycles()
+    san.assert_subgraph_of(package_lock_graph)
+
+
+def test_close_wakes_consumer_blocked_on_empty_ring():
+    """Regression for the close()-vs-blocked-next() race: with a slow
+    feeder the consumer blocks inside q.get(); close() must wake it
+    with StopIteration instead of leaving it parked on a dead queue."""
+    it = DevicePrefetchIter(HostIter(n=1000, delay=0.15),
+                            dtype="float32", depth=1)
+    done = threading.Event()
+    errs = []
+
+    def consume():
+        try:
+            while True:
+                it.next()
+        except StopIteration:
+            pass
+        except Exception as e:    # noqa: BLE001
+            errs.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.4)               # consumer is now blocked on the ring
+    it.close()
+    assert done.wait(timeout=10), "consumer hung in next() across close()"
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert not errs, errs
+
+
+def test_exhaustion_sentinel_chains_to_all_waiters():
+    """Natural end-of-epoch with multiple blocked consumers: the
+    feeder puts ONE _END; consumers must chain it so every waiter
+    unblocks."""
+    it = DevicePrefetchIter(HostIter(n=3, delay=0.05), dtype="float32",
+                            depth=1)
+    got, errs, hung = _run_consumers(it, n_threads=3, close_after_s=0.5)
+    assert not hung, hung
+    assert not errs, errs
+    assert len(got) == 3
+
+
+def test_feeder_error_unblocks_all_consumers():
+    """A feeder error puts ONE (_ERR, e); exactly one consumer must
+    surface the exception and every other blocked consumer must wake
+    with a clean StopIteration (the _ERR branch chains the sentinel
+    like the _END branch does)."""
+
+    class Boom(HostIter):
+        def next_host(self):
+            if self.i >= 1:
+                time.sleep(0.05)
+                raise RuntimeError("decode boom")
+            return super().next_host()
+
+    it = DevicePrefetchIter(Boom(n=5), dtype="float32", depth=1)
+    errs, got = [], []
+    threads = [threading.Thread(target=_consume, args=(it, got, errs))
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads), \
+        "a consumer stayed blocked after the feeder error"
+    assert len(errs) == 1 and "decode boom" in str(errs[0]), errs
+    it.close()
+
+
+def test_reset_epoch_not_poisoned_by_stale_sentinel():
+    """A consumer that loses the race against reset() may dequeue the
+    OLD queue's shutdown sentinel after the fresh epoch started; that
+    stale sentinel must not mark the new epoch exhausted."""
+    it = DevicePrefetchIter(HostIter(n=4, delay=0.08), dtype="float32",
+                            depth=1)
+
+    def consume_one():
+        try:
+            it.next()
+        except StopIteration:
+            pass
+
+    t = threading.Thread(target=consume_one)
+    q = it._q
+    t.start()
+    # wait until the consumer is REALLY parked in q.get() (the queue's
+    # not_empty waiter list is the observable), not a fixed sleep —
+    # under CI load the thread may take arbitrarily long to get there
+    deadline = time.time() + 10
+    while time.time() < deadline and not q.not_empty._waiters:
+        time.sleep(0.005)
+    assert q.not_empty._waiters, "consumer never blocked on the ring"
+    it.reset()                    # swaps the queue under the consumer
+    t.join(timeout=10)
+    assert not t.is_alive()
+    fresh = list(it)              # the NEW epoch must deliver in full
+    assert len(fresh) == 4, "stale sentinel poisoned the reset epoch"
+    it.close()
+
+
+def test_heartbeat_flap_under_sanitizer(monkeypatch,
+                                        package_lock_graph):
+    """The mxtpu-heartbeat publisher driven through a flapping
+    coordinator (the tests/test_heartbeat.py fake), started and torn
+    down inside the sanitizer: stop must join promptly and the lock
+    contract must hold."""
+    import jax
+    from jax._src import distributed as _dist
+    from mxnet_tpu import kvstore as kvs
+
+    class FlappingClient:
+        def __init__(self):
+            self.sets = []
+            self.calls = 0
+
+        def key_value_set(self, key, value, allow_overwrite=None):
+            self.calls += 1
+            if self.calls % 2 == 0:
+                raise RuntimeError("coordination service flapped")
+            self.sets.append((key, value))
+
+    client = FlappingClient()
+    monkeypatch.setattr(_dist.global_state, "client", client,
+                        raising=False)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_TIMEOUT", "2")
+    kvs._stop_liveness_heartbeat()
+    with LockOrderSanitizer() as san:
+        kvs._start_liveness_heartbeat()
+        t = kvs._hb_state["thread"]
+        assert t is not None and t.is_alive()
+        deadline = time.time() + 5
+        while len(client.sets) < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        kvs._stop_liveness_heartbeat()
+        assert not t.is_alive()
+    assert client.sets and client.sets[0][0] == kvs._HB_KEY % 0
+    san.assert_no_cycles()
+    san.assert_subgraph_of(package_lock_graph)
+
+
+@pytest.mark.slow
+def test_prefetch_stress_randomized(package_lock_graph):
+    """slow sweep: rounds of N consumers x randomized depth and close
+    timing, all inside ONE sanitizer scope so the observed graph
+    accumulates across schedules."""
+    import random
+    rng = random.Random(20260804)
+    with LockOrderSanitizer() as san:
+        for _ in range(10):
+            depth = rng.choice([1, 2, 4])
+            n_threads = rng.choice([2, 3, 4, 6])
+            it = DevicePrefetchIter(
+                HostIter(n=48, delay=rng.choice([0.0, 0.002, 0.01])),
+                dtype="float32", depth=depth)
+            got, errs, hung = _run_consumers(
+                it, n_threads=n_threads,
+                close_after_s=rng.uniform(0.0, 0.12))
+            assert not hung, hung
+            assert not errs, errs
+    san.assert_no_cycles()
+    san.assert_subgraph_of(package_lock_graph)

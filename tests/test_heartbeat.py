@@ -93,6 +93,99 @@ def test_own_rank_never_polled():
     assert c.calls == 0
 
 
+class PublishClient(FakeClient):
+    """FakeClient that also accepts the publisher's writes."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.sets = []
+        self.fail_sets = False
+
+    def key_value_set(self, key, value, allow_overwrite=None):
+        if self.fail_sets:
+            raise RuntimeError("coordination service flapped")
+        self.sets.append((key, value))
+        self.records[key] = value
+
+
+@pytest.fixture
+def publisher_env(monkeypatch):
+    """Multi-process environment without get_live_nodes: the heartbeat
+    publisher path, with a short window so beats come fast."""
+    from jax._src import distributed as _dist
+    client = PublishClient()
+    monkeypatch.setattr(_dist.global_state, "client", client,
+                        raising=False)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_TIMEOUT", "2")
+    kvs._stop_liveness_heartbeat()      # clean slate
+    yield client
+    kvs._stop_liveness_heartbeat()
+
+
+def test_heartbeat_publisher_stop_signals_and_joins(publisher_env):
+    """Regression (conc-thread-lifecycle): the publisher daemon now has
+    a paired stop Event + join.  Stop must interrupt the inter-beat
+    Event.wait instead of sleeping out the interval, and leave the
+    module state restartable."""
+    client = publisher_env
+    kvs._start_liveness_heartbeat()
+    t = kvs._hb_state["thread"]
+    assert t is not None and t.is_alive()
+    deadline = time.time() + 5
+    while not client.sets and time.time() < deadline:
+        time.sleep(0.01)
+    assert client.sets and client.sets[0][0] == kvs._HB_KEY % 0
+
+    t0 = time.time()
+    kvs._stop_liveness_heartbeat()
+    elapsed = time.time() - t0
+    assert not t.is_alive()
+    # interval is window/4 = 0.5 s; an un-signalled thread would hold
+    # the join for a full sleep — the Event.wait returns immediately
+    assert elapsed < 0.45, "stop did not interrupt the beat wait"
+    assert kvs._hb_state["thread"] is None
+    assert kvs._hb_state["stop"] is None
+    # idempotent on an already-stopped publisher
+    kvs._stop_liveness_heartbeat()
+
+    # restartable: a later store may start a fresh publisher
+    kvs._start_liveness_heartbeat()
+    t2 = kvs._hb_state["thread"]
+    assert t2 is not None and t2.is_alive() and t2 is not t
+
+
+def test_kvstore_close_stops_publisher(publisher_env):
+    """KVStoreTPU.close() is the user-facing shutdown path."""
+    kv = kvs.KVStoreTPU("tpu")
+    t = kvs._hb_state["thread"]
+    assert t is not None and t.is_alive()
+    kv.close()
+    assert not t.is_alive()
+    assert kvs._hb_state["thread"] is None
+    kv.close()                          # idempotent
+
+
+def test_publisher_survives_flap_until_stopped(publisher_env):
+    """Transient coordinator failures must not kill the publisher (5
+    consecutive misses exit); recovery resumes publishing, and stop
+    still joins cleanly mid-flap."""
+    client = publisher_env
+    client.fail_sets = True
+    kvs._start_liveness_heartbeat()
+    t = kvs._hb_state["thread"]
+    time.sleep(0.1)
+    assert t.is_alive()                 # one-ish miss is not fatal
+    client.fail_sets = False
+    deadline = time.time() + 5
+    while not client.sets and time.time() < deadline:
+        time.sleep(0.01)
+    assert client.sets, "publisher did not recover from the flap"
+    kvs._stop_liveness_heartbeat()
+    assert not t.is_alive()
+
+
 def test_num_dead_node_uses_heartbeat_fallback(monkeypatch):
     """End-to-end through KVStoreTPU.num_dead_node: a client without
     get_live_nodes routes into the heartbeat scan and survives a

@@ -42,7 +42,7 @@ def _lint_fixture(name):
 
 @pytest.mark.parametrize("name", ["fx_trace.py", "fx_retrace.py",
                                   "fx_donation.py", "fx_pallas.py",
-                                  "fx_sharding.py"])
+                                  "fx_sharding.py", "fx_concurrency.py"])
 def test_fixture_rules_and_lines(name):
     path, result = _lint_fixture(name)
     got = {(f.rule, f.line) for f in result.new}
@@ -248,6 +248,96 @@ def test_seeded_mesh_axis_bug_fails_the_gate(tmp_path):
     rules = {f.rule for f in result.new}
     assert "shard-axis-unknown" in rules, \
         "\n".join(f.render() for f in result.new)
+
+
+# pristine two-lock module shared with the runtime half of the
+# acceptance test (tests/test_runtime_lockorder.py reads the SAME
+# fixture, so both detectors exercise byte-identical modules).  The
+# seeded-bug test inverts ONE pair and the gate must trip.
+LOCKPAIR_SRC = open(os.path.join(FIXDIR, "fx_lockpair.py")).read()
+LOCKPAIR_INVERSION = (
+    "def pop():\n    with _a:\n        with _b:",
+    "def pop():\n    with _b:\n        with _a:")
+
+
+def test_seeded_lock_inversion_fails_the_gate(tmp_path):
+    """Acceptance: the pristine copy (consistent a->b order on every
+    path) is clean; inverting ONE with-pair seeds the ABBA shape and
+    must trip conc-lock-order."""
+    clean = tmp_path / "lockpair_clean.py"
+    clean.write_text(LOCKPAIR_SRC)
+    result = run_lint([str(clean)], baseline_path=None)
+    assert not result.new, "\n".join(f.render() for f in result.new)
+
+    bugged = LOCKPAIR_SRC.replace(*LOCKPAIR_INVERSION)
+    assert bugged != LOCKPAIR_SRC, "seeding site moved — update the test"
+    bad = tmp_path / "lockpair_bug.py"
+    bad.write_text(bugged)
+    result = run_lint([str(bad)], baseline_path=None)
+    rules = {f.rule for f in result.new}
+    assert "conc-lock-order" in rules, \
+        "\n".join(f.render() for f in result.new)
+
+
+def test_changed_closure_covers_conc_rules(tmp_path):
+    """Satellite: --changed's reverse-dependency closure must pull a
+    concurrency finding in an IMPORTER of the changed file (the conc
+    model is package-wide, not per-file)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text("def payload():\n    return 1\n")
+    (pkg / "worker.py").write_text(
+        "import threading\n"
+        "from .helper import payload\n"
+        "\n"
+        "_journal = []\n"
+        "\n"
+        "\n"
+        "def _run():\n"
+        "    _journal.append(payload())\n"
+        "\n"
+        "\n"
+        "def spawn():\n"
+        "    threading.Thread(target=_run, daemon=True).start()\n"
+        "\n"
+        "\n"
+        "def read():\n"
+        "    return list(_journal)\n")
+    relbase = os.path.relpath(str(pkg), REPO).replace(os.sep, "/")
+    helper_rel = relbase + "/helper.py"
+    worker_rel = relbase + "/worker.py"
+    result = run_lint([str(tmp_path)], baseline_path=None,
+                      changed_files=[helper_rel])
+    assert worker_rel in result.files
+    rules = {(f.path, f.rule) for f in result.new}
+    assert (worker_rel, "conc-unguarded-shared-write") in rules, \
+        sorted(rules)
+    assert (worker_rel, "conc-thread-lifecycle") in rules, \
+        sorted(rules)
+
+
+def test_list_rules_groups_by_family():
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--list-rules"],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    lines = res.stdout.splitlines()
+    assert "concurrency:" in lines
+    fam_of = {}
+    fam = None
+    for line in lines:
+        if line.endswith(":") and not line.startswith(" "):
+            fam = line[:-1]
+        elif line.strip():
+            fam_of[line.split()[0]] = fam
+    for rule in ("conc-lock-order", "conc-unguarded-shared-write",
+                 "conc-blocking-under-lock", "conc-thread-lifecycle",
+                 "conc-condition-wait-unlooped"):
+        assert fam_of.get(rule) == "concurrency", (rule, fam_of.get(rule))
+    assert fam_of.get("shard-axis-unknown") == "sharding"
 
 
 def test_stale_suppression_audit(tmp_path):

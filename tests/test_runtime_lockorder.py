@@ -1,0 +1,215 @@
+"""Runtime lock-order sanitizer: the dynamic half of conc-lock-order.
+
+The sanitizer wraps ``threading.Lock``/``RLock`` for a scope, records
+the observed acquisition-order graph keyed by lock CREATION site, and
+enforces two contracts against the static analyzer
+(``tools.lint.concurrency.static_lock_graph``):
+
+* observed edges between statically-known locks ⊆ static graph;
+* no cycle in the observed graph, ever.
+
+The seeded-inversion pair here is the runtime mirror of
+``tests/test_lint.py::test_seeded_lock_inversion_fails_the_gate``: the
+pristine module passes both checks, the inverted copy trips the
+runtime cycle detector exactly where the static rule fires.
+"""
+import importlib.util
+import json
+import os
+import queue
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.lint.concurrency import static_lock_graph  # noqa: E402
+from tools.lint.runtime_lockorder import LockOrderSanitizer  # noqa: E402
+
+# the SAME fixture module the static half reads (tests/test_lint.py) —
+# one source of truth, byte-identical modules under both detectors
+FIXDIR = os.path.join(REPO, "tests", "lint_fixtures")
+LOCKPAIR_SRC = open(os.path.join(FIXDIR, "fx_lockpair.py")).read()
+LOCKPAIR_BUG = LOCKPAIR_SRC.replace(
+    "def pop():\n    with _a:\n        with _b:",
+    "def pop():\n    with _b:\n        with _a:")
+assert LOCKPAIR_BUG != LOCKPAIR_SRC
+
+# lock creation sites, derived from the fixture (docstring edits must
+# not silently break the site assertions)
+_LINES = LOCKPAIR_SRC.splitlines()
+SITE_A = "lockpair.py:%d" % (
+    next(i for i, l in enumerate(_LINES, 1) if l.startswith("_a =")),)
+SITE_B = "lockpair.py:%d" % (
+    next(i for i, l in enumerate(_LINES, 1) if l.startswith("_b =")),)
+
+
+def _import_file(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_records_nesting_edges_and_sites(tmp_path):
+    p = tmp_path / "lockpair.py"
+    p.write_text(LOCKPAIR_SRC)
+    with LockOrderSanitizer(repo_root=str(tmp_path)) as san:
+        mod = _import_file(str(p), "lockpair_clean_rt")
+        mod.push()
+        mod.pop()
+    edges = san.observed_edges(repo_only=True)
+    assert edges == {(SITE_A, SITE_B)}, edges
+    assert san.lock_sites.get(SITE_A) == 1
+    # locks are restored on exit
+    assert threading.Lock is san._orig[0]
+
+
+def test_pristine_pair_passes_both_contracts(tmp_path):
+    p = tmp_path / "lockpair.py"
+    p.write_text(LOCKPAIR_SRC)
+    static = static_lock_graph([str(p)], root=str(tmp_path))
+    assert (SITE_A, SITE_B) in static["edges"]
+    with LockOrderSanitizer(repo_root=str(tmp_path)) as san:
+        mod = _import_file(str(p), "lockpair_clean_rt2")
+        mod.push()
+        mod.pop()
+    san.assert_no_cycles()
+    san.assert_subgraph_of(static)
+
+
+def test_seeded_inversion_trips_runtime_cycle(tmp_path):
+    """The inverted copy produces edges in both directions — one
+    thread is enough to OBSERVE the order inversion (no real deadlock
+    needs to happen), and assert_no_cycles must fail."""
+    p = tmp_path / "lockpair.py"
+    p.write_text(LOCKPAIR_BUG)
+    with LockOrderSanitizer(repo_root=str(tmp_path)) as san:
+        mod = _import_file(str(p), "lockpair_bug_rt")
+        mod.push()
+        mod.pop()
+    edges = san.observed_edges(repo_only=True)
+    assert (SITE_A, SITE_B) in edges
+    assert (SITE_B, SITE_A) in edges
+    with pytest.raises(AssertionError, match="cycle"):
+        san.assert_no_cycles()
+
+
+def test_subgraph_violation_is_reported(tmp_path):
+    """An observed edge the static graph does not contain fails the
+    subgraph assertion (analyzer-gap detector)."""
+    p = tmp_path / "lockpair.py"
+    p.write_text(LOCKPAIR_BUG)          # runtime sees both directions
+    pristine = tmp_path / "pristine.py"
+    pristine.write_text(LOCKPAIR_SRC)   # static graph: a->b only
+    static = static_lock_graph([str(pristine)], root=str(tmp_path))
+    # rename the static sites onto lockpair.py's coordinates so the
+    # runtime 4->3 edge is the one the static side is missing
+    static = {
+        "locks": {k.replace("pristine.py", "lockpair.py"): v
+                  for k, v in static["locks"].items()},
+        "edges": {(a.replace("pristine.py", "lockpair.py"),
+                   b.replace("pristine.py", "lockpair.py"))
+                  for a, b in static["edges"]},
+    }
+    with LockOrderSanitizer(repo_root=str(tmp_path)) as san:
+        mod = _import_file(str(p), "lockpair_bug_rt2")
+        mod.push()
+        mod.pop()
+    with pytest.raises(AssertionError, match="static"):
+        san.assert_subgraph_of(static)
+
+
+def test_wrapped_primitives_stay_functional():
+    """Sanitized locks must be drop-in: Event signalling, Queue
+    hand-off and Condition wait/notify across real threads (their
+    internals are built from the patched factories)."""
+    with LockOrderSanitizer() as san:
+        ev = threading.Event()
+        q = queue.Queue(maxsize=2)
+        cond = threading.Condition()
+        box = []
+
+        def worker():
+            ev.wait(timeout=5)
+            q.put("item")
+            with cond:
+                box.append(1)
+                cond.notify()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        ev.set()
+        assert q.get(timeout=5) == "item"
+        with cond:
+            while not box:
+                cond.wait(timeout=5)
+        t.join(timeout=5)
+        assert not t.is_alive()
+    san.assert_no_cycles()
+
+
+def test_rlock_reentry_records_no_self_edge(tmp_path):
+    p = tmp_path / "re.py"
+    p.write_text(
+        "import threading\n"
+        "_r = threading.RLock()\n"
+        "\n"
+        "\n"
+        "def twice():\n"
+        "    with _r:\n"
+        "        with _r:\n"
+        "            return 1\n")
+    with LockOrderSanitizer(repo_root=str(tmp_path)) as san:
+        mod = _import_file(str(p), "re_rt")
+        mod.twice()
+    assert san.observed_edges() == set()
+    san.assert_no_cycles()
+
+
+def test_lockorder_events_journal_and_render(tmp_path):
+    """Each fresh observed edge journals a lockorder/observed telemetry
+    event; tools/parse_log.py --jsonl renders them."""
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    p = tmp_path / "lockpair.py"
+    p.write_text(LOCKPAIR_SRC)
+    with LockOrderSanitizer(repo_root=str(tmp_path)) as san:
+        mod = _import_file(str(p), "lockpair_journal_rt")
+        mod.push()
+        mod.push()          # repeat acquisition: only ONE event per edge
+    snap = telemetry.snapshot(events=4096)
+    obs = [e for e in snap["events"]
+           if e.get("kind") == "lockorder" and e.get("name") == "observed"]
+    assert len(obs) == 1, obs
+    assert obs[0]["src"] == SITE_A
+    assert obs[0]["dst"] == SITE_B
+    assert san.observed_edges(repo_only=True)
+
+    sink = tmp_path / "journal.jsonl"
+    telemetry.export_jsonl(str(sink))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    agg = parse_log.parse_jsonl(sink.read_text().splitlines())
+    assert agg["lockorder"] == [{"src": SITE_A, "dst": SITE_B}]
+    rendered = parse_log.render_jsonl(agg)
+    assert "lockorder/observed" in rendered
+    assert "%s -> %s" % (SITE_A, SITE_B) in rendered
+    telemetry.reset()
+
+
+def test_static_graph_covers_package_locks(package_lock_graph):
+    """The package's static graph names the real lock creation sites
+    the stress tests may observe (telemetry._lock, the prefetcher
+    lifecycle lock, operator/native caches)."""
+    g = package_lock_graph
+    names = set(g["locks"].values())
+    assert "_lock" in names                      # telemetry / native
+    paths = {s.split(":")[0] for s in g["locks"]}
+    assert "mxnet_tpu/telemetry.py" in paths
+    assert "mxnet_tpu/io/device_prefetch.py" in paths

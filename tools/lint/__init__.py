@@ -1,7 +1,7 @@
 """graftlint — framework-aware static analysis for the mxnet-tpu JAX
 training stack.
 
-Five checkers (see docs/LINTING.md for the rule catalog):
+Six checkers (see docs/LINTING.md for the rule catalog):
 
 * trace-safety  — host-sync escapes inside jit-reachable code
 * retrace       — static recompile hazards (the compile-time complement
@@ -13,6 +13,11 @@ Five checkers (see docs/LINTING.md for the rule catalog):
                   (deadlock shapes) and scan-carry sharding stability
                   over the ``parallel/`` layer; its companion static
                   per-chip HBM estimator lives in ``tools.lint.hbm``
+* concurrency   — host-thread races & deadlocks: thread-entry
+                  discovery, unguarded shared writes, lock-order
+                  cycles, blocking-under-lock, thread lifecycle; its
+                  runtime counterpart is the lock-order sanitizer in
+                  ``tools.lint.runtime_lockorder``
 
 Run ``python -m tools.lint mxnet_tpu/`` (text or ``--format json``);
 ``--changed`` lints only files touched vs ``git merge-base HEAD main``
@@ -27,15 +32,17 @@ or grandfathered in ``tools/lint/baseline.json``; the tier-1 gate
 """
 from __future__ import annotations
 
-from . import donation, pallas, retrace, sharding, trace_safety
+from . import concurrency, donation, pallas, retrace, sharding, \
+    trace_safety
 from .core import (Finding, LintResult, ModuleInfo, default_baseline_path,
                    diff_baseline, load_baseline, run_lint, write_baseline)
 
-__all__ = ["CHECKERS", "all_rules", "run_lint", "Finding", "LintResult",
-           "ModuleInfo", "load_baseline", "write_baseline",
+__all__ = ["CHECKERS", "all_rules", "rule_family", "run_lint", "Finding",
+           "LintResult", "ModuleInfo", "load_baseline", "write_baseline",
            "diff_baseline", "default_baseline_path"]
 
-CHECKERS = (trace_safety, retrace, donation, pallas, sharding)
+CHECKERS = (trace_safety, retrace, donation, pallas, sharding,
+            concurrency)
 
 # rules owned by the runner itself (suppression hygiene)
 _META_RULES = {
@@ -54,3 +61,15 @@ def all_rules() -> dict:
     for c in CHECKERS:
         rules.update(c.RULES)
     return rules
+
+
+# rule-id prefix -> family name (docs/LINTING.md catalog sections;
+# mirrored by tools/parse_log.py which must stay import-free)
+_RULE_FAMILIES = {"trace": "trace-safety", "retrace": "retrace",
+                  "donate": "donation", "pallas": "pallas",
+                  "shard": "sharding", "conc": "concurrency",
+                  "lint": "meta"}
+
+
+def rule_family(rule: str) -> str:
+    return _RULE_FAMILIES.get(rule.split("-", 1)[0], "other")

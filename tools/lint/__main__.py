@@ -74,8 +74,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rid, desc in sorted(all_rules().items()):
-            print("%-28s %s" % (rid, desc))
+        from . import rule_family
+        fams = {}
+        for rid, desc in all_rules().items():
+            fams.setdefault(rule_family(rid), []).append((rid, desc))
+        for fam in sorted(fams):
+            print("%s:" % fam)
+            for rid, desc in sorted(fams[fam]):
+                print("  %-30s %s" % (rid, desc))
         return 0
 
     paths = args.paths or ["mxnet_tpu"]

@@ -1,0 +1,940 @@
+"""concurrency checker: host-thread race & deadlock analysis.
+
+The host side of this stack is genuinely threaded — the depth-K
+prefetch feeder (``io/device_prefetch.py``), the per-child producer
+threads of ``PrefetchingIter``, the ``mxtpu-heartbeat`` liveness
+publisher (``kvstore.py``) and the telemetry journal they all write
+into — and its failure modes (a torn shared write, a lock-order
+inversion, a daemon thread that outlives its owner) are invisible to
+the jit-centric rule families.  This checker partitions every scanned
+function into *thread context* (reachable from a
+``threading.Thread(target=...)`` entry — see
+``PackageIndex.thread_entries``) vs *main context* and checks:
+
+* ``conc-unguarded-shared-write`` — an attribute/module-global written
+  from thread context and read or written from main context with no
+  common ``Lock``/``RLock``/``Condition`` guard on both sides.
+  Allowlisted by design: synchronization objects themselves
+  (``Event``/``Queue``/``Semaphore``/``deque(maxlen=...)`` — their
+  methods are atomic), and immutable-constant rebinds (a
+  ``self._done = True`` stop flag is GIL-atomic);
+* ``conc-lock-order`` — the static lock-acquisition graph (``with
+  lock:`` nesting, interprocedural through the call tables via a
+  may-held-at-entry pass) contains a cycle: two call paths acquire the
+  same locks in opposite orders — the classic ABBA deadlock.  The same
+  graph is exported by :func:`static_lock_graph` and cross-checked at
+  runtime by ``tools.lint.runtime_lockorder``;
+* ``conc-blocking-under-lock`` — a blocking call (``queue.get/put``,
+  ``Event.wait``, ``Thread.join``, ``time.sleep``,
+  ``block_until_ready``) reachable while a lock is held (must-held,
+  lexically or at every call site) — it turns the lock into a
+  convoy/deadlock seed;
+* ``conc-thread-lifecycle`` — a started thread with no paired
+  stop-signal (an ``Event.set()``) + ``join`` reachable from any
+  shutdown path (``close``/``stop``/``reset``/``__del__``/... or an
+  ``atexit.register``/``weakref.finalize`` callee) — the thread
+  outlives its owner or the join hangs forever;
+* ``conc-condition-wait-unlooped`` — ``Condition.wait()`` outside a
+  ``while`` recheck loop (spurious wakeups make a plain ``if``/linear
+  wait incorrect; ``wait_for`` loops internally and is exempt).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo
+from .jitgraph import (PackageIndex, FunctionInfo, call_target_name,
+                       call_target_parts)
+
+RULES = {
+    "conc-unguarded-shared-write":
+        "attribute/global written from a thread-entry context and "
+        "accessed from main context with no common lock guard on both "
+        "sides",
+    "conc-lock-order":
+        "cycle in the static lock-acquisition graph (with-lock nesting, "
+        "interprocedural) — ABBA deadlock shape",
+    "conc-blocking-under-lock":
+        "blocking call (queue.get/put, Event.wait, Thread.join, "
+        "time.sleep, block_until_ready) reachable while a lock is held",
+    "conc-thread-lifecycle":
+        "started thread with no paired stop-signal + join on any "
+        "close/shutdown/__del__ path",
+    "conc-condition-wait-unlooped":
+        "Condition.wait() outside a while recheck loop (spurious "
+        "wakeups break if/linear waits)",
+}
+
+# constructor name -> type tag (threading.X / queue.X / collections)
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+_SYNC_CTORS = {"Event": "event", "Semaphore": "sync",
+               "BoundedSemaphore": "sync", "Barrier": "sync",
+               "Queue": "queue", "LifoQueue": "queue",
+               "PriorityQueue": "queue", "SimpleQueue": "queue",
+               "local": "sync", "Thread": "thread"}
+
+# mutation methods that count as a WRITE to the receiver object
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "remove", "clear", "update", "add", "discard", "setdefault",
+             "popitem"}
+
+# functions whose bodies count as shutdown paths for the lifecycle rule
+_SHUTDOWN_NAMES = {"close", "stop", "shutdown", "stop_and_join",
+                   "terminate", "reset", "detach", "join", "__del__",
+                   "__exit__", "finalize"}
+
+_CONST_UNARY = (ast.USub, ast.UAdd, ast.Not)
+
+
+def _is_const_expr(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, _CONST_UNARY):
+        return _is_const_expr(node.operand)
+    return False
+
+
+def _ctor_tag(node: ast.expr) -> Optional[str]:
+    """Type tag when ``node`` constructs a threading/queue sync object
+    (``threading.Lock()``, ``queue.Queue()``, ``deque(maxlen=...)``)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_target_name(node)
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    if name in _SYNC_CTORS:
+        return _SYNC_CTORS[name]
+    if name == "deque" and any(k.arg == "maxlen" and
+                               not (isinstance(k.value, ast.Constant)
+                                    and k.value.value is None)
+                               for k in node.keywords):
+        return "deque_maxlen"
+    return None
+
+
+def _enclosing_class(fi: FunctionInfo) -> Optional[str]:
+    s = fi
+    while s is not None:
+        if s.cls is not None:
+            return s.cls
+        s = s.parent
+    return None
+
+
+# a module with none of these tokens cannot create locks/threads/queues;
+# its functions are skipped by the (expensive) lexical walk.  Shared-var
+# keys are module-local (self.X attrs, module globals), so rule coverage
+# is unaffected; the one approximation is a helper in a non-threading
+# module blocking under a lock held by its cross-module caller.
+_INTERESTING_TOKENS = ("threading", "Thread", "Queue", "deque",
+                       "Semaphore", "Condition")
+
+
+def _is_interesting(module) -> bool:
+    return any(tok in module.source for tok in _INTERESTING_TOKENS)
+
+
+class _Conc:
+    """Whole-package concurrency model, built once per PackageIndex."""
+
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.thread_fns = index.thread_reachable()
+        self.interesting = {m.relpath for m in index.modules
+                            if _is_interesting(m)}
+        # var key -> set of ctor tags / creation sites / rebind flags
+        self.var_tags: Dict[tuple, Set[str]] = {}
+        self.var_sites: Dict[tuple, List[Tuple[str, int]]] = {}
+        self.var_rebound: Set[tuple] = set()
+        # per-module global names (module-level single-name assigns)
+        self.module_globals: Dict[str, Set[str]] = {}
+        for m in index.modules:
+            names: Set[str] = set()
+            for stmt in m.tree.body:
+                for t in getattr(stmt, "targets", []) or \
+                        ([stmt.target] if isinstance(
+                            stmt, (ast.AnnAssign, ast.AugAssign)) else []):
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            self.module_globals[m.relpath] = names
+        # walk products
+        self.accesses: List[dict] = []       # shared-var accesses
+        self.acquisitions: List[dict] = []   # with-lock acquisitions
+        self.blocking: List[dict] = []       # blocking calls + held set
+        self.cond_waits: List[dict] = []     # Condition.wait sites
+        self.callsite_held: Dict[int, frozenset] = {}
+        self.fn_locals: Dict[int, Set[str]] = {}
+        self.fn_globals_decl: Dict[int, Set[str]] = {}
+        self._collect_var_types()
+        for fi in index.functions:
+            if fi.module.relpath in self.interesting:
+                self._prepare_fn(fi)
+        for fi in index.functions:
+            if not isinstance(fi.node, ast.Lambda) and \
+                    fi.module.relpath in self.interesting:
+                self._walk_fn(fi)
+        self._compute_entry_held()
+        self._build_edges()
+
+    # -- var typing -----------------------------------------------------
+    def _iter_assigns(self):
+        """(fi_or_None, target, value, module) over every assignment in
+        an interesting module."""
+        for m in self.index.modules:
+            if m.relpath not in self.interesting:
+                continue
+            for stmt in m.tree.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        yield None, t, stmt.value, m
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    yield None, stmt.target, stmt.value, m
+        for fi in self.index.functions:
+            if isinstance(fi.node, ast.Lambda) or \
+                    fi.module.relpath not in self.interesting:
+                continue
+            for stmt in self.index.shallow_nodes(fi):
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        yield fi, t, stmt.value, fi.module
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    yield fi, stmt.target, stmt.value, fi.module
+
+    def _target_key(self, fi: Optional[FunctionInfo], t: ast.expr,
+                    module) -> Optional[tuple]:
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and \
+                t.value.id in ("self", "cls") and fi is not None:
+            cls = _enclosing_class(fi)
+            if cls is not None:
+                return ("attr", module.relpath, cls, t.attr)
+            return None
+        if isinstance(t, ast.Name):
+            if fi is None:
+                return ("global", module.relpath, t.id)
+            if t.id in self.fn_globals_decl.get(id(fi.node), ()):
+                return ("global", module.relpath, t.id)
+            return ("local", id(fi.node), t.id)
+        return None
+
+    def _collect_var_types(self):
+        # global declarations must be known before classifying targets
+        for fi in self.index.functions:
+            if isinstance(fi.node, ast.Lambda) or \
+                    fi.module.relpath not in self.interesting:
+                continue
+            decl: Set[str] = set()
+            for n in self.index.shallow_nodes(fi):
+                if isinstance(n, ast.Global):
+                    decl.update(n.names)
+            self.fn_globals_decl[id(fi.node)] = decl
+        for fi, t, value, module in self._iter_assigns():
+            key = self._target_key(fi, t, module)
+            if key is None:
+                continue
+            tag = _ctor_tag(value)
+            if tag is not None:
+                self.var_tags.setdefault(key, set()).add(tag)
+                self.var_sites.setdefault(key, []).append(
+                    (module.relpath, value.lineno))
+            elif fi is not None and fi.name != "__init__":
+                self.var_rebound.add(key)
+
+    def is_sync_object(self, key: tuple) -> bool:
+        """Allowlist for the shared-write rule: the var IS a
+        synchronization / thread-safe container, consistently."""
+        tags = self.var_tags.get(key)
+        return bool(tags) and key not in self.var_rebound
+
+    def resolve_var(self, fi: Optional[FunctionInfo], node: ast.expr
+                    ) -> Optional[tuple]:
+        """Var key for an expression used as a receiver/lock."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in ("self", "cls") and fi is not None:
+            cls = _enclosing_class(fi)
+            if cls is not None:
+                return ("attr", fi.module.relpath, cls, node.attr)
+            return None
+        if isinstance(node, ast.Name) and fi is not None:
+            s = fi
+            while s is not None:
+                k = ("local", id(s.node), node.id)
+                if k in self.var_tags:
+                    return k
+                if node.id in self.fn_locals.get(id(s.node), ()) and \
+                        node.id not in self.fn_globals_decl.get(
+                            id(s.node), ()):
+                    return ("local", id(s.node), node.id)
+                s = s.parent
+            if node.id in self.module_globals.get(fi.module.relpath, ()):
+                return ("global", fi.module.relpath, node.id)
+        return None
+
+    def var_tag(self, fi, node) -> Optional[str]:
+        key = self.resolve_var(fi, node)
+        tags = self.var_tags.get(key, ()) if key is not None else ()
+        if not tags and isinstance(node, ast.Name) and fi is not None:
+            # untyped local: chase its binding (`t, q = self._thread,
+            # self._q` — the local carries the attr's type)
+            chased = _chase_local(self.index, fi, node.id)
+            if chased is not None and not isinstance(chased, ast.Name):
+                key = self.resolve_var(fi, chased)
+                if key is not None:
+                    tags = self.var_tags.get(key, ())
+        return next(iter(tags)) if len(tags) == 1 else None
+
+    def resolve_lock(self, fi, node) -> Optional[tuple]:
+        key = self.resolve_var(fi, node)
+        if key is not None and \
+                self.var_tags.get(key, set()) & {"lock", "rlock",
+                                                 "condition"}:
+            return key
+        return None
+
+    # -- per-function lexical walk --------------------------------------
+    def _prepare_fn(self, fi: FunctionInfo):
+        if isinstance(fi.node, ast.Lambda):
+            self.fn_locals[id(fi.node)] = set()
+            return
+        bound: Set[str] = set(fi.param_names()) | set(fi.kwonly_names())
+        a = fi.node.args
+        if a.vararg:
+            bound.add(a.vararg.arg)
+        if a.kwarg:
+            bound.add(a.kwarg.arg)
+        for n in self.index.shallow_nodes(fi):
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, (ast.Store, ast.Del)):
+                bound.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                bound.add(n.name)
+        self.fn_locals[id(fi.node)] = bound
+
+    def _walk_fn(self, fi: FunctionInfo):
+        body = fi.node.body if not isinstance(fi.node, ast.Lambda) else []
+        for stmt in body:
+            self._walk(fi, stmt, frozenset(), False)
+
+    def _walk(self, fi, node, held, in_while):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                self._walk(fi, item.context_expr, new_held, in_while)
+                lid = self.resolve_lock(fi, item.context_expr)
+                if lid is not None:
+                    self.acquisitions.append({
+                        "lock": lid, "fi": fi,
+                        "line": item.context_expr.lineno,
+                        "col": node.col_offset, "held": new_held})
+                    new_held = new_held | {lid}
+            for stmt in node.body:
+                self._walk(fi, stmt, new_held, in_while)
+            return
+        if isinstance(node, (ast.While,)):
+            self._walk(fi, node.test, held, in_while)
+            for stmt in node.body + node.orelse:
+                self._walk(fi, stmt, held, True)
+            return
+        # statement-level write detection; an AugAssign is a
+        # read-modify-write, never an atomic constant rebind
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value if not isinstance(node, ast.AugAssign) \
+                else None
+            if value is not None or isinstance(node, ast.AugAssign):
+                for t in targets:
+                    self._record_write(fi, t, value, held)
+        if isinstance(node, ast.Call):
+            self._record_call(fi, node, held, in_while)
+            if isinstance(node.func, ast.expr):
+                self.callsite_held[id(node)] = held
+        if isinstance(node, (ast.Name, ast.Attribute)) and \
+                isinstance(getattr(node, "ctx", None), ast.Load):
+            key = self._access_key(fi, node)
+            if key is not None:
+                self.accesses.append({
+                    "key": key, "kind": "read", "fi": fi,
+                    "line": node.lineno, "col": node.col_offset,
+                    "held": held, "const": False})
+        for child in ast.iter_child_nodes(node):
+            self._walk(fi, child, held, in_while)
+
+    def _access_key(self, fi, node) -> Optional[tuple]:
+        """Shared-var key for a load/store expression: self.X attrs and
+        module globals only (locals are thread-private)."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in ("self", "cls"):
+            cls = _enclosing_class(fi)
+            if cls is not None:
+                return ("attr", fi.module.relpath, cls, node.attr)
+            return None
+        if isinstance(node, ast.Name):
+            key = self.resolve_var(fi, node)
+            if key is not None and key[0] == "global":
+                return key
+        return None
+
+    def _record_write(self, fi, target, value, held):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._record_write(fi, e, value, held)
+            return
+        node = target
+        if isinstance(target, ast.Subscript):
+            node = target.value
+            value = None        # container mutation, never a pure rebind
+        key = self._access_key(fi, node)
+        if key is None:
+            return
+        self.accesses.append({
+            "key": key, "kind": "write", "fi": fi,
+            "line": target.lineno, "col": target.col_offset,
+            "held": held,
+            "const": value is not None and _is_const_expr(value)})
+
+    def _record_call(self, fi, node: ast.Call, held, in_while):
+        name = call_target_name(node)
+        parts = call_target_parts(node)
+        recv = node.func.value if isinstance(node.func, ast.Attribute) \
+            else None
+        recv_tag = self.var_tag(fi, recv) if recv is not None else None
+        # mutation methods on shared containers count as writes
+        if name in _MUTATORS and recv is not None:
+            key = self._access_key(fi, recv)
+            if key is not None:
+                self.accesses.append({
+                    "key": key, "kind": "write", "fi": fi,
+                    "line": node.lineno, "col": node.col_offset,
+                    "held": held, "const": False})
+        # blocking calls.  Condition.wait releases the condition's OWN
+        # lock while waiting — only OTHER held locks make it a hazard
+        # (the unlooped-wait rule owns the wait itself).
+        blocked = None
+        held_for_block = held
+        if name == "sleep" and (len(parts) == 1
+                                or parts[0] in ("time", "_time")):
+            blocked = "time.sleep"
+        elif name == "wait" and recv_tag == "event":
+            blocked = "event.wait"
+        elif name in ("wait", "wait_for") and recv_tag == "condition":
+            own = self.resolve_var(fi, recv)
+            held_for_block = frozenset(held) - {own}
+            if held_for_block:
+                blocked = "condition.wait"
+        elif name == "join" and recv_tag == "thread":
+            blocked = "Thread.join"
+        elif name in ("get", "put") and recv_tag == "queue":
+            if not any(k.arg == "block" and
+                       isinstance(k.value, ast.Constant) and
+                       k.value.value is False for k in node.keywords):
+                blocked = "queue.%s" % name
+        elif name == "block_until_ready":
+            blocked = "block_until_ready"
+        elif name == "acquire" and recv is not None and \
+                self.resolve_lock(fi, recv) is not None:
+            blocked = "Lock.acquire"
+        if blocked is not None:
+            self.blocking.append({
+                "what": blocked, "fi": fi, "line": node.lineno,
+                "col": node.col_offset, "held": held_for_block})
+        if name == "wait" and recv_tag == "condition":
+            self.cond_waits.append({
+                "fi": fi, "line": node.lineno, "col": node.col_offset,
+                "in_while": in_while})
+
+    # -- interprocedural held sets --------------------------------------
+    def _entry_pass(self, combine, init):
+        out: Dict[int, frozenset] = {}
+        # run to convergence (the loop breaks as soon as a sweep is
+        # quiet); the bound only guards against oscillation and must
+        # exceed the deepest call chain a held set can propagate down
+        for _ in range(len(self.index.functions) + 2):
+            changed = False
+            for fi in self.index.functions:
+                sites = self.index._calls_by_callee.get(id(fi.node), ())
+                vals = []
+                for cs in sites:
+                    h = self.callsite_held.get(id(cs.node))
+                    if h is None:
+                        vals.append(frozenset())
+                        continue
+                    caller = out.get(id(cs.scope.node), init) \
+                        if cs.scope is not None else frozenset()
+                    vals.append(h | caller)
+                new = combine(vals) if vals else frozenset()
+                if out.get(id(fi.node), init) != new:
+                    out[id(fi.node)] = new
+                    changed = True
+            if not changed:
+                break
+        return out
+
+    def _compute_entry_held(self):
+        # must-held: a lock credited as a guard must be held on EVERY
+        # path into the function; may-held over-approximates for the
+        # lock-order graph
+        self.must_entry = self._entry_pass(
+            lambda vs: frozenset.intersection(*vs), frozenset())
+        self.may_entry = self._entry_pass(
+            lambda vs: frozenset.union(*vs), frozenset())
+
+    def effective_held(self, rec, must=True) -> frozenset:
+        table = self.must_entry if must else self.may_entry
+        return rec["held"] | table.get(id(rec["fi"].node), frozenset())
+
+    # -- lock-order graph ------------------------------------------------
+    def _build_edges(self):
+        self.edges: Dict[Tuple[tuple, tuple], List[dict]] = {}
+        for acq in self.acquisitions:
+            held = acq["held"] | self.may_entry.get(
+                id(acq["fi"].node), frozenset())
+            for h in held:
+                if h == acq["lock"]:
+                    continue
+                self.edges.setdefault((h, acq["lock"]), []).append(acq)
+        # self-nesting of a plain (non-reentrant) Lock is an immediate
+        # deadlock — record it as a self-edge
+        for acq in self.acquisitions:
+            if acq["lock"] in acq["held"] and \
+                    self.var_tags.get(acq["lock"]) == {"lock"}:
+                self.edges.setdefault((acq["lock"], acq["lock"]),
+                                      []).append(acq)
+
+    def cyclic_edge_sites(self) -> List[Tuple[Tuple[tuple, tuple], dict]]:
+        succ: Dict[tuple, Set[tuple]] = {}
+        for (a, b) in self.edges:
+            succ.setdefault(a, set()).add(b)
+
+        def reaches(src, dst):
+            seen, todo = set(), [src]
+            while todo:
+                cur = todo.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                todo.extend(succ.get(cur, ()))
+            return False
+
+        out = []
+        for (a, b), sites in self.edges.items():
+            if a == b or reaches(b, a):
+                for s in sites:
+                    out.append(((a, b), s))
+        return out
+
+
+def _conc(index: PackageIndex) -> _Conc:
+    model = getattr(index, "_conc_model", None)
+    if model is None:
+        model = _Conc(index)
+        index._conc_model = model
+    return model
+
+
+def _var_label(key: tuple) -> str:
+    if key[0] == "attr":
+        return "%s.%s" % (key[2], key[3])
+    if key[0] == "global":
+        return key[2]
+    return key[2]
+
+
+# ---------------------------------------------------------------------------
+# rule passes (run ONCE over the whole package, bucketed per module —
+# re-deriving them per scanned file would be O(files x accesses))
+# ---------------------------------------------------------------------------
+
+def _shared_write_findings(model: _Conc) -> List[Finding]:
+    by_key: Dict[tuple, dict] = {}
+    for a in model.accesses:
+        fi = a["fi"]
+        if fi.name == "__init__":
+            continue            # construction happens-before publication
+        key = a["key"]
+        ent = by_key.setdefault(key, {"thread_w": [], "main": []})
+        if id(fi.node) in model.thread_fns:
+            if a["kind"] == "write" and not a["const"]:
+                ent["thread_w"].append(a)
+        else:
+            ent["main"].append(a)
+    out = []
+    for key, ent in by_key.items():
+        if not ent["thread_w"] or not ent["main"]:
+            continue
+        if model.is_sync_object(key):
+            continue
+        hit = None
+        for w in sorted(ent["thread_w"], key=lambda r: (r["line"],
+                                                        r["col"])):
+            wg = model.effective_held(w)
+            for a in sorted(ent["main"], key=lambda r: (r["line"],
+                                                        r["col"])):
+                if not (wg & model.effective_held(a)):
+                    hit = (w, a)
+                    break
+            if hit:
+                break
+        if hit is None:
+            continue
+        w, a = hit
+        out.append(Finding(
+            "conc-unguarded-shared-write", key[1], w["line"],
+            w["col"],
+            "%r is written on the %s thread here but accessed from "
+            "main-context %s (%s:%d) with no common lock held on both "
+            "sides" % (_var_label(key), w["fi"].name, a["fi"].qualname,
+                       a["fi"].module.relpath, a["line"]),
+            w["fi"].qualname))
+    return out
+
+
+def _lock_order_findings(model: _Conc) -> List[Finding]:
+    out = []
+    seen = set()
+    for (a, b), acq in model.cyclic_edge_sites():
+        rel = acq["fi"].module.relpath
+        dedup = (rel, acq["line"], a, b)
+        if dedup in seen:
+            continue
+        seen.add(dedup)
+        if a == b:
+            msg = "non-reentrant lock %r re-acquired while already " \
+                  "held — immediate self-deadlock" % (_var_label(a),)
+        else:
+            msg = "lock %r acquired while holding %r, but another " \
+                  "path acquires them in the opposite order — ABBA " \
+                  "deadlock" % (_var_label(b), _var_label(a))
+        out.append(Finding("conc-lock-order", rel,
+                           acq["line"], acq["col"], msg,
+                           acq["fi"].qualname))
+    return out
+
+
+def _blocking_findings(model: _Conc) -> List[Finding]:
+    out = []
+    for rec in model.blocking:
+        held = model.effective_held(rec, must=True)
+        if not held:
+            continue
+        lock = sorted(_var_label(h) for h in held)[0]
+        out.append(Finding(
+            "conc-blocking-under-lock", rec["fi"].module.relpath,
+            rec["line"], rec["col"],
+            "%s called while lock %r is held — blocks every other "
+            "thread contending for it (convoy/deadlock seed)"
+            % (rec["what"], lock), rec["fi"].qualname))
+    return out
+
+
+def _cond_wait_findings(model: _Conc) -> List[Finding]:
+    out = []
+    for rec in model.cond_waits:
+        if rec["in_while"]:
+            continue
+        out.append(Finding(
+            "conc-condition-wait-unlooped", rec["fi"].module.relpath,
+            rec["line"], rec["col"],
+            "Condition.wait() outside a while recheck loop — spurious "
+            "wakeups make the predicate unreliable; use `while not "
+            "pred: cond.wait()` or wait_for()", rec["fi"].qualname))
+    return out
+
+
+# -- thread lifecycle --------------------------------------------------------
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    if call_target_name(node) != "Thread":
+        return False
+    parts = call_target_parts(node)
+    return len(parts) <= 1 or parts[-2] == "threading"
+
+
+def _chase_local(index, fi, name: str) -> Optional[ast.expr]:
+    s = fi
+    while s is not None:
+        for stmt in index.shallow_nodes(s):
+            if not isinstance(stmt, ast.Assign) or \
+                    len(stmt.targets) != 1:
+                continue
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name) and t.id == name:
+                return stmt.value
+            # pairwise tuple unpack: `t, q = self._thread, self._q`
+            if isinstance(t, ast.Tuple) and \
+                    isinstance(stmt.value, ast.Tuple) and \
+                    len(t.elts) == len(stmt.value.elts):
+                for te, ve in zip(t.elts, stmt.value.elts):
+                    if isinstance(te, ast.Name) and te.id == name:
+                        return ve
+        s = s.parent
+    return None
+
+
+def _handle_descriptor(model: _Conc, fi, expr) -> Optional[tuple]:
+    """Normalize a thread-handle expression: ``self.X`` attrs,
+    module-global names, and holder-container reads
+    (``_state["thread"]`` / ``holder.get("thread")``)."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id in ("self", "cls"):
+        cls = _enclosing_class(fi)
+        return ("attr", fi.module.relpath, cls, expr.attr) if cls else None
+    if isinstance(expr, ast.Subscript):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in \
+                model.module_globals.get(fi.module.relpath, ()):
+            return ("holder", fi.module.relpath, base.id)
+        return None
+    if isinstance(expr, ast.Call) and \
+            call_target_name(expr) == "get" and \
+            isinstance(expr.func, ast.Attribute) and \
+            isinstance(expr.func.value, ast.Name):
+        base = expr.func.value
+        if base.id in model.module_globals.get(fi.module.relpath, ()):
+            return ("holder", fi.module.relpath, base.id)
+        return None
+    if isinstance(expr, ast.Name):
+        key = model.resolve_var(fi, expr)
+        if key is not None and key[0] == "global":
+            return key
+        chased = _chase_local(model.index, fi, expr.id)
+        if chased is not None and not isinstance(chased, ast.Name):
+            return _handle_descriptor(model, fi, chased)
+    return None
+
+
+def _shutdown_reachable(index: PackageIndex) -> Set[int]:
+    """Function-node-ids reachable from a shutdown-path entry: a
+    function named like a teardown hook, or one registered with
+    ``atexit.register`` / ``weakref.finalize``."""
+    roots: Set[int] = set()
+    for fi in index.functions:
+        if not isinstance(fi.node, ast.Lambda) and \
+                fi.name in _SHUTDOWN_NAMES:
+            roots.add(id(fi.node))
+    for cs in index.call_sites:
+        name = call_target_name(cs.node)
+        cand = None
+        if name == "register" and call_target_parts(cs.node)[:1] == \
+                ("atexit",) and cs.node.args:
+            cand = cs.node.args[0]
+        elif name == "finalize" and len(cs.node.args) >= 2:
+            cand = cs.node.args[1]
+        if cand is not None:
+            fi = index._resolve_thread_target(cs, cand)
+            if fi is not None:
+                roots.add(id(fi.node))
+    reach = set(roots)
+    changed = True
+    while changed:
+        changed = False
+        for cs in index.call_sites:
+            if cs.scope is None or id(cs.scope.node) not in reach:
+                continue
+            if cs.callee is not None and id(cs.callee.node) not in reach:
+                reach.add(id(cs.callee.node))
+                changed = True
+    return reach
+
+
+def _lifecycle_findings(index: PackageIndex,
+                        model: _Conc) -> List[Finding]:
+    shutdown = _shutdown_reachable(index)
+
+    # joins + Event.set()s on shutdown paths, package-wide
+    joins: Set[tuple] = set()
+    stop_sets: Set[tuple] = set()
+    for cs in index.call_sites:
+        if cs.scope is None or not isinstance(cs.node.func, ast.Attribute):
+            continue
+        if id(cs.scope.node) not in shutdown:
+            continue
+        name = cs.node.func.attr
+        if name == "join":
+            d = _handle_descriptor(model, cs.scope, cs.node.func.value)
+            if d is not None:
+                joins.add(d)
+        elif name == "set":
+            key = model.resolve_var(cs.scope, cs.node.func.value)
+            if key is not None and \
+                    "event" in model.var_tags.get(key, ()):
+                stop_sets.add(key)
+            else:
+                d = _handle_descriptor(model, cs.scope,
+                                       cs.node.func.value)
+                if d is not None:
+                    stop_sets.add(d)
+
+    out = []
+    for fi in index.functions:
+        if isinstance(fi.node, ast.Lambda) or \
+                fi.module.relpath not in model.interesting:
+            continue
+        rel = fi.module.relpath
+        for node in index.shallow_nodes(fi):
+            if not (isinstance(node, ast.Call)
+                    and _is_thread_ctor(node)):
+                continue
+            handle, started = _handle_and_started(model, fi, node)
+            if not started:
+                continue
+            cls = _enclosing_class(fi)
+            joined = handle is not None and handle in joins
+            # a class-held thread needs a stop signal scoped to ITS
+            # class; module-level threads accept any same-module
+            # global/holder (or class) signal
+            if handle is not None and handle[0] == "attr":
+                stopped = any(k[0] == "attr" and k[1] == rel
+                              and k[2] == handle[2] for k in stop_sets)
+            else:
+                stopped = any(
+                    (k[0] == "attr" and cls is not None
+                     and k[1] == rel and k[2] == cls)
+                    or (k[0] in ("global", "holder") and k[1] == rel)
+                    for k in stop_sets)
+            if joined and stopped:
+                continue
+            if not joined:
+                what = "no join() of this thread is reachable " \
+                       "from any close/stop/__del__/atexit path"
+            else:
+                what = "no stop-signal (Event.set()) is reachable " \
+                       "from any shutdown path — the join can " \
+                       "hang forever"
+            out.append(Finding(
+                "conc-thread-lifecycle", rel,
+                node.lineno, node.col_offset,
+                "thread started here outlives its owner: %s"
+                % what, fi.qualname))
+    return out
+
+
+def _handle_and_started(model: _Conc, fi,
+                        ctor: ast.Call) -> Tuple[Optional[tuple], bool]:
+    """(handle descriptor, started?) for a Thread construction site,
+    resolved within the constructing function."""
+    index = model.index
+    handle = None
+    local_name = None
+    for stmt in index.shallow_nodes(fi):
+        if isinstance(stmt, ast.Assign) and stmt.value is ctor and \
+                len(stmt.targets) == 1:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                local_name = t.id
+                key = model.resolve_var(fi, t)
+                if key is not None and key[0] == "global":
+                    handle = key
+            else:
+                handle = _handle_descriptor(model, fi, t) or \
+                    (model._target_key(fi, t, fi.module)
+                     if isinstance(t, ast.Attribute) else None)
+    started = False
+    for stmt in index.shallow_nodes(fi):
+        if not isinstance(stmt, ast.Call) or \
+                not isinstance(stmt.func, ast.Attribute) or \
+                stmt.func.attr != "start":
+            continue
+        recv = stmt.func.value
+        if recv is ctor:
+            started = True
+        elif isinstance(recv, ast.Name) and recv.id == local_name:
+            started = True
+        elif handle is not None and \
+                _handle_descriptor(model, fi, recv) == handle:
+            started = True
+    if local_name is not None:
+        # promotion of the local into an attr/global/holder
+        for stmt in index.shallow_nodes(fi):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Name) and \
+                    stmt.value.id == local_name and \
+                    len(stmt.targets) == 1:
+                d = _handle_descriptor(model, fi, stmt.targets[0])
+                if d is None and isinstance(stmt.targets[0],
+                                            ast.Attribute):
+                    d = model._target_key(fi, stmt.targets[0],
+                                          fi.module)
+                if d is None and isinstance(stmt.targets[0],
+                                            ast.Subscript):
+                    d = _handle_descriptor(model, fi, stmt.targets[0])
+                if d is not None:
+                    handle = d
+    return handle, started
+
+
+# ---------------------------------------------------------------------------
+# checker entry + static graph export
+# ---------------------------------------------------------------------------
+
+def check(module: ModuleInfo, index: PackageIndex) -> List[Finding]:
+    model = _conc(index)
+    cached = getattr(index, "_conc_findings", None)
+    if cached is None:
+        cached = {}
+        all_findings = (_shared_write_findings(model)
+                        + _lock_order_findings(model)
+                        + _blocking_findings(model)
+                        + _cond_wait_findings(model)
+                        + _lifecycle_findings(index, model))
+        for f in all_findings:
+            cached.setdefault(f.path, []).append(f)
+        index._conc_findings = cached
+    return list(cached.get(module.relpath, ()))
+
+
+def static_lock_graph(paths: Sequence[str],
+                      root: Optional[str] = None) -> dict:
+    """Build the static lock-acquisition graph over ``paths`` for the
+    runtime sanitizer cross-check (``tools.lint.runtime_lockorder``).
+
+    Returns ``{"locks": {"relpath:line": name}, "edges":
+    {("relpath:line", "relpath:line"), ...}}`` — nodes are lock
+    CREATION sites (the ``threading.Lock()`` call), matching how the
+    runtime wrapper attributes the locks it observes.  ``root``
+    defaults to the repo root; pass the sanitizer's ``repo_root`` when
+    checking code outside the repo (test fixtures)."""
+    import os
+    from .core import collect_files, ModuleInfo as MI, _repo_root
+
+    root = os.path.abspath(root) if root else _repo_root()
+    modules = []
+    for path in collect_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        try:
+            modules.append(MI(path, rel, src))
+        except SyntaxError:
+            continue
+    index = PackageIndex(modules)
+    model = _conc(index)
+    locks = {}
+    site_of: Dict[tuple, List[str]] = {}
+    for key, sites in model.var_sites.items():
+        if not (model.var_tags.get(key, set()) & {"lock", "rlock",
+                                                  "condition"}):
+            continue
+        for rel, line in sites:
+            site = "%s:%d" % (rel, line)
+            locks[site] = _var_label(key)
+            site_of.setdefault(key, []).append(site)
+    edges = set()
+    for (a, b) in model.edges:
+        for sa in site_of.get(a, ()):
+            for sb in site_of.get(b, ()):
+                edges.add((sa, sb))
+    return {"locks": locks, "edges": edges}

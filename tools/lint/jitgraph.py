@@ -748,6 +748,88 @@ class PackageIndex:
                     todo.append(imp)
         return seen
 
+    # -- host-thread entries (concurrency checker) ----------------------
+    def _resolve_thread_target(self, cs: CallSite, node: ast.expr
+                               ) -> Optional[FunctionInfo]:
+        """Resolve a ``threading.Thread(target=...)`` expression: plain
+        names and ``partial`` ride :meth:`resolve_call`; ``Cls.method``
+        spellings (the prefetcher's ``DevicePrefetchIter._feed``) and
+        ``self.method`` resolve through the method table."""
+        if isinstance(node, ast.Call) and \
+                call_target_name(node) == "partial" and node.args:
+            return self._resolve_thread_target(cs, node.args[0])
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            hit = self.methods.get(
+                (cs.module.relpath, node.value.id, node.attr))
+            if hit is not None:
+                return hit
+        return self.resolve_call(cs.module, cs.scope, node)
+
+    def thread_entries(self) -> Dict[int, str]:
+        """{function-node-id: entry description} for every function a
+        ``threading.Thread(target=...)`` call site names (the host-side
+        analogue of :meth:`_mark_entries`' tracing wrappers)."""
+        cached = getattr(self, "_thread_entries", None)
+        if cached is not None:
+            return cached
+        entries: Dict[int, str] = {}
+        for cs in self.call_sites:
+            if call_target_name(cs.node) != "Thread":
+                continue
+            parts = call_target_parts(cs.node)
+            if len(parts) > 1 and parts[-2] != "threading":
+                continue
+            target = None
+            for kw in cs.node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None and len(cs.node.args) > 1:
+                # threading.Thread(group, target, ...) positional form
+                target = cs.node.args[1]
+            if target is None:
+                continue
+            fi = self._resolve_thread_target(cs, target)
+            if fi is not None:
+                entries.setdefault(
+                    id(fi.node), "%s:%d" % (cs.module.relpath,
+                                            cs.node.lineno))
+        self._thread_entries = entries
+        return entries
+
+    def thread_reachable(self) -> Set[int]:
+        """Function-node-ids reachable from a thread entry — the code
+        that runs OFF the main thread.  Propagation follows resolved
+        call sites plus one receiver-blind step: inside a
+        thread-reachable function of class ``C``, an unresolved
+        ``<expr>.m(...)`` call resolves to ``C.m`` when it exists (the
+        weakref-deref idiom ``it = wref(); it._ship(...)``)."""
+        cached = getattr(self, "_thread_reachable", None)
+        if cached is not None:
+            return cached
+        reach: Set[int] = set(self.thread_entries())
+        changed = True
+        while changed:
+            changed = False
+            for cs in self.call_sites:
+                if cs.scope is None or id(cs.scope.node) not in reach:
+                    continue
+                callee = cs.callee
+                if callee is None and \
+                        isinstance(cs.node.func, ast.Attribute):
+                    s, cls = cs.scope, None
+                    while s is not None and cls is None:
+                        cls = s.cls
+                        s = s.parent
+                    if cls is not None:
+                        callee = self.methods.get(
+                            (cs.module.relpath, cls, cs.node.func.attr))
+                if callee is not None and id(callee.node) not in reach:
+                    reach.add(id(callee.node))
+                    changed = True
+        self._thread_reachable = reach
+        return reach
+
     # -- queries --------------------------------------------------------
     def function_at(self, node) -> Optional[FunctionInfo]:
         return self.by_node.get(id(node))

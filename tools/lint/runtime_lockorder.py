@@ -1,0 +1,261 @@
+"""Runtime lock-order sanitizer: the dynamic half of ``conc-lock-order``.
+
+``LockOrderSanitizer`` is a context manager that replaces
+``threading.Lock``/``threading.RLock`` with recording wrappers for the
+duration of a designated stress test.  Every lock **created inside the
+scope** is attributed to its creation site (the first stack frame under
+the repo root), and every acquisition taken while the same thread
+already holds other sanitized locks records an observed
+acquisition-order edge ``held-site -> acquired-site``.
+
+The contract mirrors PR 6's static-vs-runtime HBM cross-check:
+
+* the **observed** graph restricted to statically-known lock sites must
+  be a *subgraph* of the static graph
+  (:func:`tools.lint.concurrency.static_lock_graph`) — if the runtime
+  ever witnesses a nesting the analyzer did not derive, either the code
+  grew an unmodeled acquisition path or the analyzer regressed;
+* a **cycle** in the observed graph is a hard failure regardless of
+  what the static side knows — two threads really did acquire the same
+  locks in opposite orders.
+
+Locks created before entering the scope (module-level locks like
+``telemetry._lock``) are not wrapped — the sanitizer sees the locks the
+scenario under test creates (prefetcher/queue/event internals, fixture
+locks), which is exactly the surface a stress test exercises.  Each
+newly observed edge is journaled as a ``lockorder/observed`` telemetry
+event (rendered by ``tools/parse_log.py --jsonl``).
+
+Usage::
+
+    from tools.lint.runtime_lockorder import LockOrderSanitizer
+    from tools.lint.concurrency import static_lock_graph
+
+    with LockOrderSanitizer() as san:
+        ...drive the threaded scenario...
+    san.assert_no_cycles()
+    san.assert_subgraph_of(static_lock_graph(["mxnet_tpu"]))
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import _repo_root
+
+
+class _SanitizedLock:
+    """Transparent wrapper over a real lock that reports acquisitions
+    to its sanitizer.  Compatible with ``threading.Condition``'s duck
+    typing (``acquire``/``release``/``__enter__``/``__exit__``; RLock
+    extras delegate through ``__getattr__``)."""
+
+    def __init__(self, inner, san: "LockOrderSanitizer",
+                 site: Optional[str], reentrant: bool):
+        self._inner = inner
+        self._san = san
+        self._site = site
+        self._reentrant = reentrant
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._san._acquired(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._san._released(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+
+    def __getattr__(self, name):
+        # RLock internals Condition probes for (_release_save,
+        # _acquire_restore, _is_owned) resolve here iff the inner lock
+        # has them — hasattr() keeps working for plain Locks
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return "<SanitizedLock %s %r>" % (self._site or "<anon>",
+                                          self._inner)
+
+
+class LockOrderSanitizer:
+    """Record the lock-acquisition-order graph of a threaded scenario.
+
+    ``repo_root``: creation frames under this directory become lock
+    sites (``relpath:line``); everything else (stdlib ``queue``
+    internals, test harness frames outside the repo) stays anonymous —
+    anonymous locks participate in cycle detection but are excluded
+    from the static-subgraph comparison.
+    """
+
+    def __init__(self, repo_root: Optional[str] = None,
+                 telemetry_events: bool = True):
+        self.repo_root = os.path.abspath(repo_root or _repo_root())
+        self.telemetry_events = telemetry_events
+        # (src_site, dst_site) -> acquisition count; sites are
+        # "relpath:line" or "<anon:N>" for out-of-repo creations
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.lock_sites: Dict[str, int] = {}     # site -> locks created
+        self._held = threading.local()
+        self._orig: Optional[tuple] = None
+        self._reclock = threading.Lock()         # created UNWRAPPED
+        self._anon = 0
+        self._active = False
+
+    # -- patching -------------------------------------------------------
+    def __enter__(self):
+        if self._active:
+            raise RuntimeError("LockOrderSanitizer is not reentrant")
+        self._orig = (threading.Lock, threading.RLock)
+
+        def make_lock():
+            return self._wrap(self._orig[0](), reentrant=False)
+
+        def make_rlock():
+            return self._wrap(self._orig[1](), reentrant=True)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        self._active = True
+        return self
+
+    def __exit__(self, *exc):
+        threading.Lock, threading.RLock = self._orig
+        self._active = False
+        return False
+
+    # -- recording ------------------------------------------------------
+    def _creation_site(self) -> Optional[str]:
+        f = sys._getframe(2)
+        skip = (os.path.abspath(__file__),)
+        while f is not None:
+            fn = f.f_code.co_filename
+            if not fn.startswith("<") and os.path.abspath(fn) not in skip \
+                    and not fn.endswith(("threading.py", "queue.py")):
+                path = os.path.abspath(fn)
+                if path.startswith(self.repo_root + os.sep):
+                    rel = os.path.relpath(path, self.repo_root)
+                    return "%s:%d" % (rel.replace(os.sep, "/"), f.f_lineno)
+                return None
+            f = f.f_back
+        return None
+
+    def _wrap(self, inner, reentrant: bool) -> _SanitizedLock:
+        site = self._creation_site()
+        if site is None:
+            with self._reclock:
+                self._anon += 1
+                site = "<anon:%d>" % self._anon
+        else:
+            with self._reclock:
+                self.lock_sites[site] = self.lock_sites.get(site, 0) + 1
+        return _SanitizedLock(inner, self, site, reentrant)
+
+    def _stack(self) -> List[_SanitizedLock]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def _acquired(self, lock: _SanitizedLock):
+        st = self._stack()
+        new = []
+        for held in st:
+            if held is lock:        # RLock re-entry: no self-edge
+                continue
+            if held._site != lock._site:
+                new.append((held._site, lock._site))
+        st.append(lock)
+        if new:
+            with self._reclock:
+                fresh = [e for e in new if e not in self.edges]
+                for e in new:
+                    self.edges[e] = self.edges.get(e, 0) + 1
+            if fresh and self.telemetry_events:
+                try:
+                    from mxnet_tpu import telemetry
+                    for src, dst in fresh:
+                        telemetry.event("lockorder", "observed",
+                                        src=src, dst=dst)
+                except Exception:
+                    pass
+
+    def _released(self, lock: _SanitizedLock):
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lock:
+                del st[i]
+                break
+
+    # -- queries / assertions -------------------------------------------
+    def observed_edges(self, repo_only: bool = False
+                       ) -> Set[Tuple[str, str]]:
+        with self._reclock:
+            edges = set(self.edges)
+        if repo_only:
+            edges = {(a, b) for a, b in edges
+                     if not a.startswith("<anon") and
+                     not b.startswith("<anon")}
+        return edges
+
+    def cycles(self) -> List[List[str]]:
+        """Cycles in the observed graph (each as a site list with the
+        start repeated at the end)."""
+        succ: Dict[str, Set[str]] = {}
+        for a, b in self.observed_edges():
+            succ.setdefault(a, set()).add(b)
+        out, state = [], {}
+
+        def dfs(node, path):
+            state[node] = 1
+            path.append(node)
+            for nxt in sorted(succ.get(node, ())):
+                if state.get(nxt) == 1:
+                    out.append(path[path.index(nxt):] + [nxt])
+                elif state.get(nxt) is None:
+                    dfs(nxt, path)
+            path.pop()
+            state[node] = 2
+
+        for node in sorted(succ):
+            if state.get(node) is None:
+                dfs(node, [])
+        return out
+
+    def assert_no_cycles(self):
+        cyc = self.cycles()
+        assert not cyc, (
+            "runtime lock-order cycle observed (threads acquired the "
+            "same locks in opposite orders):\n  "
+            + "\n  ".join(" -> ".join(c) for c in cyc))
+
+    def assert_subgraph_of(self, static_graph: dict):
+        """Every observed edge between two statically-known lock sites
+        must exist in ``static_graph`` (the
+        :func:`tools.lint.concurrency.static_lock_graph` result) — the
+        runtime graph is a subgraph of the derived one."""
+        known = set(static_graph.get("locks", ()))
+        static_edges = set(static_graph.get("edges", ()))
+        missing = [(a, b) for a, b in self.observed_edges(repo_only=True)
+                   if a in known and b in known
+                   and (a, b) not in static_edges]
+        assert not missing, (
+            "runtime observed lock-order edges the static analyzer did "
+            "not derive (analyzer gap or unmodeled acquisition path):\n  "
+            + "\n  ".join("%s -> %s" % e for e in sorted(missing)))

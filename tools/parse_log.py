@@ -82,6 +82,7 @@ def parse_jsonl(lines):
     gauges = {}
     recompiles = []
     hbm = {}
+    lockorder = []
     lint_gate = None
     steps = 0
     for line in lines:
@@ -111,6 +112,11 @@ def parse_jsonl(lines):
             key = "%s/%s" % (rec.get("program", "?"),
                              rec.get("mode", "?"))
             hbm[key] = rec
+        elif kind == "lockorder":
+            # runtime lock-order sanitizer observations (one event per
+            # newly observed acquisition edge — tools.lint.runtime_lockorder)
+            lockorder.append({"src": rec.get("src"),
+                              "dst": rec.get("dst")})
         elif kind == "lint" and rec.get("name") == "gate":
             lint_gate = rec
         elif kind == "snapshot":
@@ -125,7 +131,7 @@ def parse_jsonl(lines):
         s["total_ms"] = round(s["total_ms"], 4)
     return {"spans": spans, "counters": counters, "gauges": gauges,
             "recompiles": recompiles, "steps": steps, "hbm": hbm,
-            "lint_gate": lint_gate}
+            "lockorder": lockorder, "lint_gate": lint_gate}
 
 
 def _render_hbm(hbm, fmt="markdown"):
@@ -181,14 +187,22 @@ def render_jsonl(agg, fmt="markdown"):
         for r in agg["recompiles"]:
             out.append("  %s (#%s): %s" % (r["name"], r["n"],
                                            "; ".join(r["changed"])))
+    if agg.get("lockorder"):
+        out.append("")
+        out.append("lockorder/observed acquisition edges "
+                   "(runtime sanitizer):")
+        for e in agg["lockorder"]:
+            out.append("  %s -> %s" % (e["src"], e["dst"]))
     out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
 
 
-# rule-id prefix -> checker family (docs/LINTING.md catalog sections)
+# rule-id prefix -> checker family (docs/LINTING.md catalog sections;
+# mirrors tools.lint.rule_family — this script stays import-free)
 _RULE_FAMILIES = {"trace": "trace-safety", "retrace": "retrace",
                   "donate": "donation", "pallas": "pallas",
-                  "shard": "sharding", "lint": "meta"}
+                  "shard": "sharding", "conc": "concurrency",
+                  "lint": "meta"}
 
 
 def _rule_family(rule):
