@@ -29,10 +29,21 @@ class Monitor:
     pattern : regex on tensor names.
     sort : sort output by name.
     monitor_all : include arguments/gradients, not just outputs.
+    nan_guard : bool, default False — with :meth:`attach`, sweep the
+        trainer's params and grads for non-finite values EVERY step
+        (not just on the stats interval) and ``logging.warning`` on the
+        first hit with the step index and leaf name, then stand down
+        (warn-once).  Backed by the runtime numerics sanitizer's
+        finite-ness gauges: the first offending leaf journals a
+        ``numerics/observed`` telemetry event, so the first-NaN step is
+        recoverable from the journal even when the log line scrolled
+        away.  Costs one
+        ``isfinite`` reduction + device sync per leaf per step — the
+        debug knob for a loss that went NaN, not an always-on default.
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
-                 monitor_all=True):
+                 monitor_all=True, nan_guard=False):
         if stat_func is None:
             def stat_func(x):
                 return x.abs().mean() if hasattr(x, "abs") else x
@@ -45,6 +56,8 @@ class Monitor:
         self.re_prog = re.compile(pattern)
         self.sort = sort
         self.monitor_all = monitor_all
+        self.nan_guard = nan_guard
+        self._nan_warned = False
         self._hook = None
         self._attached = []
 
@@ -62,6 +75,8 @@ class Monitor:
                 if rec.get("source") != "trainer" or \
                         rec.get("owner") not in self._attached:
                     return
+                if self.nan_guard and not self._nan_warned:
+                    self._nan_sweep(rec["owner"], rec["index"])
                 self.tic()
                 if not self.activated:
                     return
@@ -79,6 +94,38 @@ class Monitor:
             telemetry.remove_step_hook(self._hook)
             self._hook = None
         self._attached = []
+
+    def _nan_sweep(self, trainer, step_idx):
+        """nan_guard: warn once on the FIRST non-finite param/grad leaf
+        (step + leaf name), journaling one ``numerics/observed`` event
+        for that leaf, then stand down — later leaves/steps are not
+        reported (clean sweeps journal nothing)."""
+        import jax.numpy as jnp
+        for p in trainer._params:
+            leaves = [(p.name, p.data() if p._data is not None else None)]
+            if p.grad_req != "null" and p._grad is not None:
+                leaves.append((p.name + "_grad", p.grad()))
+            for name, arr in leaves:
+                if arr is None:
+                    continue
+                data = getattr(arr, "_data", arr)
+                # NOT dtype.kind: ml_dtypes' bfloat16 registers as 'V'
+                if not jnp.issubdtype(data.dtype, jnp.inexact):
+                    continue
+                bad = int(data.size - int(jnp.isfinite(data).sum()))
+                if not bad:
+                    continue
+                telemetry.event("numerics", "observed", leaf=name,
+                                dtype=str(data.dtype), nonfinite=bad,
+                                size=int(data.size), step=step_idx,
+                                role="nan_guard")
+                logging.warning(
+                    "Monitor nan_guard: non-finite values in %r at "
+                    "step %d (%d of %d elements)", name, step_idx,
+                    bad, int(data.size))
+                self._nan_warned = True
+                return True         # warn-once: first leaf, first step
+        return False
 
     def _collect_trainer(self, trainer, step_idx):
         """[(step, name, stat_str)] over a Trainer's params (and grads

@@ -43,6 +43,26 @@ def _seed_rng():
 
 
 @pytest.fixture(scope="session")
+def package_scan():
+    """THE tier-1 full-package graftlint scan — baseline + suppression
+    audit + telemetry in ONE run (~5 s) shared by the gate,
+    stale-suppression and changed-mode tests (tests/test_lint.py).
+    Session-scoped so every rule family's gate tests — the numerics
+    additions included — reuse one scan instead of paying it per
+    module."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.lint import run_lint
+    baseline = os.path.join(repo, "tools", "lint", "baseline.json")
+    return run_lint([os.path.join(repo, "mxnet_tpu")],
+                    baseline_path=baseline if os.path.exists(baseline)
+                    else None, emit_telemetry=True,
+                    audit_suppressions=True)
+
+
+@pytest.fixture(scope="session")
 def package_lock_graph():
     """ONE static lock graph over mxnet_tpu/ shared by every runtime
     lock-order cross-check (tests/test_concurrency_stress.py,

@@ -1,0 +1,241 @@
+"""numerics fixture: seeded dtype-flow violations.
+
+Each violation line carries an expect-rule marker asserted exactly by
+tests/test_lint.py.  Every allowlisted idiom has a clean twin next to
+its seeded bug: explicit ``preferred_element_type`` contractions,
+max-shift-guarded softmax/exp, intentional (explicitly cast) bf16
+all-gather, dtype-pinned reductions — the checker's precision contract
+is that these stay silent.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mxnet_tpu.parallel.collectives import (all_gather_unpad,
+                                            reduce_scatter_padded)
+
+
+# -- implicit promotion ------------------------------------------------------
+
+@jax.jit
+def promotion_bad(x):
+    h = x.astype(jnp.bfloat16)
+    f = x.astype(jnp.float32)
+    return h * f  # expect: num-implicit-promotion
+
+
+@jax.jit
+def promotion_explicit_is_clean(x):
+    h = x.astype(jnp.bfloat16)
+    f = x.astype(jnp.float32)
+    return h.astype(jnp.float32) * f
+
+
+@jax.jit
+def promotion_weak_literal_is_clean(x):
+    # a Python literal is weak-typed: it does NOT promote bf16
+    h = x.astype(jnp.bfloat16)
+    return h * 0.5
+
+
+@jax.jit
+def promotion_via_call_bad(x):
+    h = x.astype(jnp.float16)
+    f = jnp.ones((4,), jnp.float32)
+    return jnp.add(h, f)  # expect: num-implicit-promotion
+
+
+# -- low-precision accumulation ----------------------------------------------
+
+@jax.jit
+def accum_sum_bad(x):
+    h = x.astype(jnp.bfloat16)
+    return jnp.sum(h)  # expect: num-lowprec-accum
+
+
+@jax.jit
+def accum_sum_dtype_is_clean(x):
+    h = x.astype(jnp.bfloat16)
+    return jnp.sum(h, dtype=jnp.float32)
+
+
+@jax.jit
+def accum_sum_upcast_is_clean(x):
+    h = x.astype(jnp.bfloat16)
+    return jnp.sum(h.astype(jnp.float32))
+
+
+@jax.jit
+def accum_matmul_bad(a, b):
+    ah = a.astype(jnp.bfloat16)
+    bh = b.astype(jnp.bfloat16)
+    return jnp.matmul(ah, bh)  # expect: num-lowprec-accum
+
+
+@jax.jit
+def accum_matmul_pet_is_clean(a, b):
+    ah = a.astype(jnp.bfloat16)
+    bh = b.astype(jnp.bfloat16)
+    return jnp.matmul(ah, bh, preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def accum_einsum_bad(a, b):
+    ah = a.astype(jnp.float16)
+    return jnp.einsum("ij,jk->ik", ah, b.astype(jnp.float16))  # expect: num-lowprec-accum
+
+
+@jax.jit
+def accum_mean_method_bad(x):
+    h = x.astype(jnp.bfloat16)
+    return h.mean()  # expect: num-lowprec-accum
+
+
+# -- unstable transcendentals ------------------------------------------------
+
+@jax.jit
+def exp_unshifted_bad(x):
+    h = x.astype(jnp.float16)
+    return jnp.exp(h)  # expect: num-unstable-exp
+
+
+@jax.jit
+def exp_max_shift_is_clean(x):
+    h = x.astype(jnp.float16)
+    m = jnp.max(h, axis=-1, keepdims=True)
+    return jnp.exp(h - m)
+
+
+@jax.jit
+def exp_neg_abs_is_clean(x):
+    # exp(-|x|) <= 1: the stable-BCE form cannot overflow
+    h = x.astype(jnp.float16)
+    return jnp.exp(-jnp.abs(h))
+
+
+@jax.jit
+def softmax_half_bad(x):
+    h = x.astype(jnp.bfloat16)
+    return jax.nn.softmax(h, axis=-1)  # expect: num-unstable-exp
+
+
+@jax.jit
+def softmax_upcast_is_clean(x):
+    h = x.astype(jnp.bfloat16)
+    return jax.nn.softmax(h.astype(jnp.float32), axis=-1)
+
+
+@jax.jit
+def log_unguarded_bad(p):
+    h = p.astype(jnp.float16)
+    return jnp.log(h)  # expect: num-unstable-exp
+
+
+@jax.jit
+def log_eps_is_clean(p):
+    h = p.astype(jnp.float16)
+    return jnp.log(h + 1e-6)
+
+
+# -- fp32 master contract ----------------------------------------------------
+
+@jax.jit
+def master_halved_bad(w, g):
+    master = w.astype(jnp.bfloat16)  # expect: num-master-dtype
+    return master - g
+
+
+@jax.jit
+def master_kept_fp32_is_clean(w, g):
+    master = w.astype(jnp.float32)
+    new_master = master - g.astype(jnp.float32)
+    return new_master.astype(w.dtype), new_master
+
+
+@jax.jit
+def master_half_update_bad(w, g, lr):
+    master = w.astype(jnp.float32)
+    gh = g.astype(jnp.bfloat16)
+    return _apply_update(master, gh, lr)  # expect: num-master-dtype
+
+
+@jax.jit
+def master_upcast_update_is_clean(w, g, lr):
+    master = w.astype(jnp.float32)
+    return _apply_update(master, g.astype(jnp.float32), lr)
+
+
+def _apply_update(wv, gv, lr):
+    return wv - lr * gv
+
+
+@jax.jit
+def roundtrip_bad(w):
+    return w.astype(jnp.bfloat16).astype(jnp.float32)  # expect: num-master-dtype
+
+
+@jax.jit
+def requantize_once_is_clean(w):
+    # a single downcast at the end (working-dtype handoff) is the mp
+    # contract, not a round-trip
+    m = w.astype(jnp.float32)
+    return (m * 2.0).astype(jnp.bfloat16)
+
+
+# -- collective dtype symmetry -----------------------------------------------
+
+@jax.jit
+def collective_pair_bad(g):
+    g32 = g.astype(jnp.float32)
+    shard = reduce_scatter_padded(g32, "dp", axis_size=8)
+    half = shard.astype(jnp.bfloat16)
+    out = all_gather_unpad(half, (100,), "dp")  # expect: num-collective-dtype
+    return out
+
+
+@jax.jit
+def collective_pair_explicit_is_clean(g):
+    # the intentional bf16 all-gather: the cast sits ON the gather
+    # operand, so the working-dtype handoff is visible at the pair
+    g32 = g.astype(jnp.float32)
+    shard = reduce_scatter_padded(g32, "dp", axis_size=8)
+    return all_gather_unpad(shard.astype(jnp.bfloat16), (100,), "dp")
+
+
+@jax.jit
+def collective_pair_same_dtype_is_clean(g):
+    g32 = g.astype(jnp.float32)
+    shard = reduce_scatter_padded(g32, "dp", axis_size=8)
+    return all_gather_unpad(shard, (100,), "dp")
+
+
+# -- float64 / weak-literal surprises ----------------------------------------
+
+@jax.jit
+def f64_dtype_bad(x):
+    return jnp.zeros(x.shape, dtype=jnp.float64)  # expect: num-const-downcast
+
+
+@jax.jit
+def np_default_float_bad(x):
+    table = np.array([0.1, 0.2, 0.7])  # expect: num-const-downcast
+    return x * jnp.asarray(table)
+
+
+@jax.jit
+def np_explicit_dtype_is_clean(x):
+    table = np.array([0.1, 0.2, 0.7], dtype=np.float32)
+    return x * jnp.asarray(table)
+
+
+@jax.jit
+def f16_literal_overflow_bad(x):
+    h = x.astype(jnp.float16)
+    return h * 1.0e5  # expect: num-const-downcast
+
+
+@jax.jit
+def f16_literal_in_range_is_clean(x):
+    h = x.astype(jnp.float16)
+    return h * 3.0e4

@@ -1,0 +1,58 @@
+"""Pristine mini ZeRO sharded update — the shared seeded-bug module.
+
+A self-contained replica of ``collectives.zero_sharded_update``'s mp
+path with the bf16 working dtype made explicit, shared by BOTH halves
+of the numerics acceptance test (the PR-7 ``fx_lockpair`` pattern):
+
+* tests/test_lint.py seeds the bug statically — dropping the fp32
+  upcast (``g16.astype(jnp.float32)`` -> ``g16``) must trip
+  ``num-lowprec-accum`` (and ``num-implicit-promotion``), while THIS
+  pristine copy scans clean;
+* tests/test_runtime_numerics.py runs the same pristine/seeded pair on
+  the 8-device CPU mesh under ``NumericsSanitizer`` — the observed
+  dtypes of the watched values must match ``static_dtype_flow`` of the
+  pristine module, and the seeded copy must violate the check
+  dynamically.
+
+Both tests read THIS file, so the two detectors exercise
+byte-identical modules.
+"""
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mxnet_tpu.parallel.collectives import (all_gather_unpad,
+                                            reduce_scatter_padded)
+
+AXIS = "dp"
+N_SHARDS = 8
+
+
+def make_mesh(devices):
+    return Mesh(devices, (AXIS,))
+
+
+def zero_momentum_step(mesh, w, g, lr):
+    """One ZeRO-sharded SGD step: half-width wire gradient, fp32
+    master/accum shards, working-dtype all-gather.  Returns
+    ``(new_weight, master_shard, grad_norm)``."""
+
+    def body(wb, gb, lrb):
+        g16 = gb.astype(jnp.float16)                # wire/working dtype
+        g32 = g16.astype(jnp.float32)               # fp32 upcast (the
+        #                                             seeded bug drops it)
+        gshard = reduce_scatter_padded(g32, AXIS,
+                                       axis_size=N_SHARDS) / N_SHARDS
+        gnorm = lax.psum(jnp.sum(gshard * gshard), AXIS)
+        mshard = reduce_scatter_padded(
+            wb.astype(jnp.float32), AXIS, axis_size=N_SHARDS) / N_SHARDS
+        lr32 = lrb.astype(jnp.float32)
+        new_master = mshard - lr32 * gshard
+        half = all_gather_unpad(new_master.astype(jnp.float16),
+                                wb.shape, AXIS)
+        return half, new_master, gnorm
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(), P(), P()),
+        out_specs=(P(), P(AXIS), P()), check_rep=False)(w, g, lr)

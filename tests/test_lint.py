@@ -42,7 +42,8 @@ def _lint_fixture(name):
 
 @pytest.mark.parametrize("name", ["fx_trace.py", "fx_retrace.py",
                                   "fx_donation.py", "fx_pallas.py",
-                                  "fx_sharding.py", "fx_concurrency.py"])
+                                  "fx_sharding.py", "fx_concurrency.py",
+                                  "fx_numerics.py"])
 def test_fixture_rules_and_lines(name):
     path, result = _lint_fixture(name)
     got = {(f.rule, f.line) for f in result.new}
@@ -156,16 +157,10 @@ def test_baseline_diff_multiplicity(tmp_path):
     assert len(new) == 1 and len(old) == 1
 
 
-@pytest.fixture(scope="module")
-def package_scan():
-    """THE tier-1 full-package scan — baseline + suppression audit +
-    telemetry in ONE run (~5 s) shared by the gate, stale-suppression
-    and changed-mode tests."""
-    baseline = os.path.join(REPO, "tools", "lint", "baseline.json")
-    return run_lint([os.path.join(REPO, "mxnet_tpu")],
-                    baseline_path=baseline if os.path.exists(baseline)
-                    else None, emit_telemetry=True,
-                    audit_suppressions=True)
+# THE tier-1 full-package scan fixture (`package_scan`) is
+# session-scoped in tests/conftest.py — shared by the gate,
+# stale-suppression and changed-mode tests here so every rule family
+# (numerics included) pays for ONE scan.
 
 
 def test_package_gate_zero_findings(package_scan):
@@ -279,6 +274,66 @@ def test_seeded_lock_inversion_fails_the_gate(tmp_path):
         "\n".join(f.render() for f in result.new)
 
 
+# pristine mini ZeRO update shared with the runtime half of the
+# acceptance test (tests/test_runtime_numerics.py runs the SAME
+# fixture on the mesh, so both detectors exercise byte-identical
+# modules).  The seeded-bug test drops the fp32 upcast and the gate
+# must trip.
+ZERO_UPDATE_SRC = open(os.path.join(FIXDIR, "fx_zero_update.py")).read()
+ZERO_UPDATE_SEED = ("g16.astype(jnp.float32)", "g16")
+
+
+def test_seeded_lowprec_accum_fails_the_gate(tmp_path):
+    """Acceptance: the pristine mini ZeRO update (explicit fp32 upcast
+    before the reduce-scatter) is clean; dropping the upcast seeds the
+    low-precision-accumulation bug and must trip num-lowprec-accum
+    (the grad-norm now sums in float16) plus num-implicit-promotion
+    (the master update now mixes f32 and f16)."""
+    clean = tmp_path / "zero_clean.py"
+    clean.write_text(ZERO_UPDATE_SRC)
+    result = run_lint([str(clean)], baseline_path=None)
+    assert not result.new, "\n".join(f.render() for f in result.new)
+
+    bugged = ZERO_UPDATE_SRC.replace(*ZERO_UPDATE_SEED)
+    assert bugged != ZERO_UPDATE_SRC, "seeding site moved — update the test"
+    bad = tmp_path / "zero_bug.py"
+    bad.write_text(bugged)
+    result = run_lint([str(bad)], baseline_path=None)
+    rules = {f.rule for f in result.new}
+    assert "num-lowprec-accum" in rules, \
+        "\n".join(f.render() for f in result.new)
+    assert "num-implicit-promotion" in rules, \
+        "\n".join(f.render() for f in result.new)
+
+
+def test_changed_closure_covers_num_rules(tmp_path):
+    """Satellite: --changed's reverse-dependency closure must pull a
+    numerics finding in an IMPORTER of the changed file (the dtype-flow
+    model resolves helpers cross-module)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text("def scale():\n    return 2\n")
+    (pkg / "worker.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from .helper import scale\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def reduce_loss(x):\n"
+        "    h = x.astype(jnp.bfloat16)\n"
+        "    return jnp.sum(h) * scale()\n")
+    relbase = os.path.relpath(str(pkg), REPO).replace(os.sep, "/")
+    helper_rel = relbase + "/helper.py"
+    worker_rel = relbase + "/worker.py"
+    result = run_lint([str(tmp_path)], baseline_path=None,
+                      changed_files=[helper_rel])
+    assert worker_rel in result.files
+    rules = {(f.path, f.rule) for f in result.new}
+    assert (worker_rel, "num-lowprec-accum") in rules, sorted(rules)
+
+
 def test_changed_closure_covers_conc_rules(tmp_path):
     """Satellite: --changed's reverse-dependency closure must pull a
     concurrency finding in an IMPORTER of the changed file (the conc
@@ -338,6 +393,11 @@ def test_list_rules_groups_by_family():
                  "conc-condition-wait-unlooped"):
         assert fam_of.get(rule) == "concurrency", (rule, fam_of.get(rule))
     assert fam_of.get("shard-axis-unknown") == "sharding"
+    assert "numerics:" in lines
+    for rule in ("num-implicit-promotion", "num-lowprec-accum",
+                 "num-unstable-exp", "num-master-dtype",
+                 "num-collective-dtype", "num-const-downcast"):
+        assert fam_of.get(rule) == "numerics", (rule, fam_of.get(rule))
 
 
 def test_stale_suppression_audit(tmp_path):

@@ -113,14 +113,19 @@ def test_parse_log_lint_report_rule_families():
              "context": "f"},
             {"rule": "trace-host-sync", "path": "m.py", "line": 9,
              "col": 0, "message": "float() sync", "context": "g"},
+            {"rule": "num-lowprec-accum", "path": "m.py", "line": 12,
+             "col": 0, "message": "sum() accumulates in bfloat16",
+             "context": "h"},
         ],
     }
     agg = parse_log.parse_lint(json.dumps(report))
     assert agg["by_rule"] == {"shard-axis-unknown": 1,
-                              "trace-host-sync": 1}
+                              "trace-host-sync": 1,
+                              "num-lowprec-accum": 1}
     out = parse_log.render_lint(agg)
     assert "| sharding | shard-axis-unknown | 1 |" in out
     assert "| trace-safety | trace-host-sync | 1 |" in out
+    assert "| numerics | num-lowprec-accum | 1 |" in out
     assert "axis 'pd' undeclared" in out
 
 

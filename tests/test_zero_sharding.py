@@ -7,11 +7,15 @@ under ``multi_precision`` — and composes with donation, ``scan_steps``,
 uneven leaf sizes, and the 1-device degenerate mesh (so the whole
 matrix runs in tier-1 on the virtual 8-device CPU mesh).
 """
+import os
+
 import numpy as onp
 import pytest
 
 import jax
 import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon, parallel, telemetry
@@ -277,17 +281,31 @@ def _trainer_epoch(net, tr, mesh, shard, k=4):
 
 def test_trainer_sharded_matches_replicated(mesh8):
     """Trainer(shard_optimizer=True) with mesh-replicated params: same
-    trained parameters, state mirror dp-sharded, donate_grads composes."""
+    trained parameters, state mirror dp-sharded, donate_grads composes.
+    The sharded leg runs under the runtime numerics sanitizer — the
+    ZeRO update must keep every param/grad leaf finite and
+    dtype-stable across steps (the working-dtype contract's dynamic
+    half)."""
+    import sys
+    sys.path.insert(0, REPO) if REPO not in sys.path else None
+    from tools.lint.runtime_numerics import NumericsSanitizer
     na, ta = _trainer_setup(mesh8, False)
     nb, tb = _trainer_setup(mesh8, True, donate_grads=True)
     _trainer_epoch(na, ta, mesh8, False)
-    _trainer_epoch(nb, tb, mesh8, True)
+    san = NumericsSanitizer().attach(tb)
+    try:
+        _trainer_epoch(nb, tb, mesh8, True)
+    finally:
+        san.detach()
     _params_close(na, nb)
     fused = tb._kv_fused or tb._local_fused
     assert fused._sharded, "sharded mirror did not engage"
     leaf = next(iter(fused._sharded.values()))[0]
     assert leaf.ndim == 1 and \
         leaf.addressable_shards[0].data.shape[0] == leaf.shape[0] // 8
+    assert san.observed, "sanitizer sweep never ran"
+    san.assert_all_finite()
+    san.assert_no_dtype_drift()
 
 
 def test_trainer_sharded_state_serialization(mesh8, tmp_path):

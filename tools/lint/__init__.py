@@ -1,7 +1,7 @@
 """graftlint — framework-aware static analysis for the mxnet-tpu JAX
 training stack.
 
-Six checkers (see docs/LINTING.md for the rule catalog):
+Seven checkers (see docs/LINTING.md for the rule catalog):
 
 * trace-safety  — host-sync escapes inside jit-reachable code
 * retrace       — static recompile hazards (the compile-time complement
@@ -18,6 +18,12 @@ Six checkers (see docs/LINTING.md for the rule catalog):
                   cycles, blocking-under-lock, thread lifecycle; its
                   runtime counterpart is the lock-order sanitizer in
                   ``tools.lint.runtime_lockorder``
+* numerics      — dtype-flow analysis: implicit promotions,
+                  low-precision accumulation, unstable transcendentals,
+                  fp32-master and collective working-dtype contracts,
+                  float64-under-disabled-x64 surprises; its runtime
+                  counterpart is the numerics sanitizer in
+                  ``tools.lint.runtime_numerics``
 
 Run ``python -m tools.lint mxnet_tpu/`` (text or ``--format json``);
 ``--changed`` lints only files touched vs ``git merge-base HEAD main``
@@ -32,8 +38,8 @@ or grandfathered in ``tools/lint/baseline.json``; the tier-1 gate
 """
 from __future__ import annotations
 
-from . import concurrency, donation, pallas, retrace, sharding, \
-    trace_safety
+from . import concurrency, donation, numerics, pallas, retrace, \
+    sharding, trace_safety
 from .core import (Finding, LintResult, ModuleInfo, default_baseline_path,
                    diff_baseline, load_baseline, run_lint, write_baseline)
 
@@ -42,7 +48,7 @@ __all__ = ["CHECKERS", "all_rules", "rule_family", "run_lint", "Finding",
            "diff_baseline", "default_baseline_path"]
 
 CHECKERS = (trace_safety, retrace, donation, pallas, sharding,
-            concurrency)
+            concurrency, numerics)
 
 # rules owned by the runner itself (suppression hygiene)
 _META_RULES = {
@@ -68,7 +74,7 @@ def all_rules() -> dict:
 _RULE_FAMILIES = {"trace": "trace-safety", "retrace": "retrace",
                   "donate": "donation", "pallas": "pallas",
                   "shard": "sharding", "conc": "concurrency",
-                  "lint": "meta"}
+                  "num": "numerics", "lint": "meta"}
 
 
 def rule_family(rule: str) -> str:

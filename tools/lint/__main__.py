@@ -45,7 +45,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.lint",
         description="graftlint: framework-aware static analysis "
-                    "(trace-safety, retrace, donation, Pallas)")
+                    "(trace-safety, retrace, donation, Pallas, "
+                    "sharding, concurrency, numerics)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to lint (default: mxnet_tpu/)")
     ap.add_argument("--format", choices=("text", "json"), default="text")

@@ -1,0 +1,852 @@
+"""numerics checker: dtype-flow analysis over jit-reachable code.
+
+Every remaining reduced-precision leg of this stack — bf16 training,
+the int8 serving path, the ZeRO fp32-master / working-dtype update
+contract (arxiv 2004.13336) — fails *silently* when a dtype goes wrong:
+an implicit bf16→f32 promotion doubles HBM traffic, a bf16 accumulation
+swallows gradient mass, an unshifted ``exp`` overflows half floats, a
+collective pair that changes dtype mid-flight corrupts the flat ZeRO
+layout.  The TPU serving comparison (arxiv 2605.25645) shows the
+bf16/int8 precision choice dominates both throughput and quality, so a
+wrong dtype is simultaneously a performance and a correctness bug.
+
+The checker propagates a small dtype lattice through each jit-reachable
+function (over the same :class:`~tools.lint.jitgraph.PackageIndex`
+closure the trace/retrace rules use): concrete dtypes (``float32``,
+``bfloat16``, ...), weak-typed Python literals (``weak_float`` /
+``weak_int`` — they do NOT promote, mirroring JAX's weak-type rules),
+and unknown (⊤, on which every rule stays silent).  Transfer functions
+cover ``astype`` / ``asarray`` / constructors / ``zeros_like`` /
+``preferred_element_type`` / ``promote_types`` / reductions /
+elementwise passthrough, plus one level of local-helper return-dtype
+resolution through :meth:`PackageIndex.resolve_call`.
+
+Rules (each with its runtime counterpart in
+``tools.lint.runtime_numerics`` — see docs/LINTING.md):
+
+* ``num-implicit-promotion`` — a binary op mixing a 16-bit float with a
+  wider float, relying on silent promotion;
+* ``num-lowprec-accum`` — sum/mean/matmul/einsum reducing 16-bit floats
+  without fp32 accumulation (``preferred_element_type=`` / ``dtype=`` /
+  an explicit upcast);
+* ``num-unstable-exp`` — exp/log/softmax/logsumexp over 16-bit floats
+  with no max-shift / eps-guard / upcast;
+* ``num-master-dtype`` — the multi_precision fp32 master leaf assigned
+  a half-width value, an update applied to the master with a half-width
+  operand, or an ``astype`` round-trip through a half dtype;
+* ``num-collective-dtype`` — a reduce-scatter/all-gather pair over one
+  axis whose dtypes differ with no explicit conversion (the ZeRO
+  working-dtype contract, composing with ``shard-collective-pairing``);
+* ``num-const-downcast`` — float64 requested (or numpy's float64
+  default relied on) under disabled x64, and weak literals beyond the
+  float16 range.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo
+from .jitgraph import (PackageIndex, FunctionInfo, call_target_name,
+                       call_target_parts, fold_or_none)
+from .sharding import _chase_name
+from .tainting import NUMPY_ROOTS
+
+RULES = {
+    "num-implicit-promotion":
+        "binary op mixes a 16-bit float with a wider float — silent "
+        "promotion; make it explicit with astype or align dtypes",
+    "num-lowprec-accum":
+        "sum/mean/matmul/einsum reduces 16-bit floats without fp32 "
+        "accumulation (preferred_element_type/dtype=/explicit upcast)",
+    "num-unstable-exp":
+        "exp/log/softmax/logsumexp over 16-bit floats without "
+        "max-shift, eps-guard or upcast",
+    "num-master-dtype":
+        "fp32 master leaf leaves float32 (half-width assignment, "
+        "half-width update operand, or astype round-trip)",
+    "num-collective-dtype":
+        "reduce-scatter/all-gather pair over one axis with asymmetric "
+        "dtypes and no explicit conversion (ZeRO working-dtype "
+        "contract)",
+    "num-const-downcast":
+        "float64 constant/dtype under disabled x64 (silent downcast), "
+        "or a weak literal outside the float16 range",
+}
+
+# -- the lattice -------------------------------------------------------------
+
+WEAK_FLOAT = "weak_float"
+WEAK_INT = "weak_int"
+
+HALF_FLOATS = {"float16", "bfloat16"}
+WIDE_FLOATS = {"float32", "float64"}
+CONCRETE_FLOATS = HALF_FLOATS | WIDE_FLOATS
+INTS = {"int8", "uint8", "int16", "uint16", "int32", "uint32", "int64",
+        "uint64"}
+
+# attribute / string spellings -> canonical dtype
+_DTYPE_NAMES = {
+    "float16": "float16", "half": "float16", "bfloat16": "bfloat16",
+    "float32": "float32", "single": "float32", "float64": "float64",
+    "double": "float64", "float_": "float64", "int8": "int8",
+    "uint8": "uint8", "int16": "int16", "uint16": "uint16",
+    "int32": "int32", "uint32": "uint32", "int64": "int64",
+    "uint64": "uint64", "bool_": "bool",
+}
+
+_F_ORDER = {"float16": 1, "bfloat16": 1, "float32": 2, "float64": 3}
+
+# float16 finite range — a weak literal beyond it overflows f16 operands
+_F16_MAX = 65504.0
+
+
+def promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """JAX's promote_types restricted to this lattice (x64 disabled:
+    weak Python literals never widen a concrete operand)."""
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    for x, y in ((a, b), (b, a)):
+        if x == WEAK_INT:
+            return y
+        if x == WEAK_FLOAT:
+            if y in CONCRETE_FLOATS or y == WEAK_FLOAT:
+                return y
+            if y in INTS or y == "bool":
+                return "float32"
+            return None
+    if a in CONCRETE_FLOATS and b in CONCRETE_FLOATS:
+        if a in HALF_FLOATS and b in HALF_FLOATS:
+            return "float32"        # f16 + bf16 promotes to f32
+        return a if _F_ORDER[a] >= _F_ORDER[b] else b
+    if a in CONCRETE_FLOATS:
+        return a
+    if b in CONCRETE_FLOATS:
+        return b
+    return None                     # int/int and exotica: not rule-relevant
+
+
+# -- call vocabularies -------------------------------------------------------
+
+# first-operand passthrough: result dtype == dtype of the FIRST array
+# operand (later args are config — axes, shapes, pad widths, indices)
+_PASSTHROUGH_FIRST = {
+    "exp", "expm1", "exp2", "log", "log1p", "log2", "log10", "sqrt",
+    "rsqrt", "abs", "absolute", "negative", "square", "tanh", "sigmoid",
+    "relu", "gelu", "erf", "sin", "cos", "sign", "floor", "ceil",
+    "round", "rint", "clip",
+    "reshape", "ravel", "flatten", "transpose", "swapaxes", "squeeze",
+    "expand_dims", "broadcast_to", "pad", "roll", "flip", "take",
+    "take_along_axis", "gather", "dynamic_slice", "tile", "repeat",
+    "stop_gradient", "with_sharding_constraint", "device_put",
+    "max", "min", "amax", "amin", "softmax", "log_softmax",
+    "logsumexp", "flatten_pad", "unflatten", "psum", "pmean",
+    "all_gather", "psum_scatter", "ppermute", "all_to_all",
+    "reduce_scatter", "reduce_scatter_padded", "all_gather_unpad",
+}
+# join passthrough: result dtype == promote over every array operand
+_PASSTHROUGH_JOIN = {"add", "subtract", "multiply", "divide",
+                     "true_divide", "power", "logaddexp", "maximum",
+                     "minimum", "where", "hypot", "concatenate",
+                     "stack"}
+# of these, the genuinely binary ones participate in the
+# implicit-promotion rule alongside ast.BinOp
+_BINARY_CALLS = {"add", "subtract", "multiply", "divide", "true_divide",
+                 "power", "logaddexp", "maximum", "minimum", "where"}
+
+_REDUCE_CALLS = {"sum", "mean", "prod", "cumsum", "var", "std",
+                 "nansum", "average"}
+_MATMUL_CALLS = {"matmul", "dot", "einsum", "tensordot", "dot_general",
+                 "conv_general_dilated", "conv", "vdot"}
+_CTOR_CALLS = {"zeros", "ones", "full", "empty", "arange", "linspace",
+               "eye", "identity"}
+_LIKE_CALLS = {"zeros_like", "ones_like", "full_like", "empty_like"}
+
+_EXP_CALLS = {"exp", "expm1", "exp2"}
+_LOG_CALLS = {"log", "log2", "log10"}
+_SOFTMAX_CALLS = {"softmax", "log_softmax", "logsumexp"}
+
+_RS_CALLS = {"reduce_scatter", "reduce_scatter_padded", "psum_scatter"}
+_AG_CALLS = {"all_gather", "all_gather_unpad"}
+
+# roots that make `root.fn(x)` a module call, not a method on an array
+_MODULE_ROOTS = {"jnp", "np", "onp", "numpy", "jax", "lax", "nn", "pl",
+                 "pltpu", "scipy", "special", "linalg", "random",
+                 "collectives", "mx", "npx"}
+
+
+def _receiver(call: ast.Call) -> Optional[ast.expr]:
+    """The array receiver of a method call (``x.sum()`` -> ``x``), or
+    None when the callee is a module function (``jnp.sum(x)``)."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    parts = call_target_parts(call)
+    if parts and parts[0] in _MODULE_ROOTS:
+        return None
+    return call.func.value
+
+_MASTER_RE_PARTS = ("master",)
+
+
+def _is_master_name(name: str) -> bool:
+    low = name.lower()
+    return any(p in low for p in _MASTER_RE_PARTS)
+
+
+# ---------------------------------------------------------------------------
+# dtype environment (one per function, cached on the index)
+# ---------------------------------------------------------------------------
+
+class DtypeEnv:
+    """Flow-insensitive dtype lattice over one function's locals.
+
+    Optimistic fixpoint in the :class:`~tools.lint.tainting.Taint`
+    style: bindings whose value dtype resolves join into ``types``;
+    a name bound to two *different* concrete dtypes becomes a conflict
+    (permanently unknown) so every rule stays silent on it.  Parameters
+    start unknown — in-package evidence (``astype``, constructors,
+    ``preferred_element_type``) is what seeds the lattice, which is
+    exactly the precision/recall trade the zero-findings gate needs.
+    """
+
+    def __init__(self, index: PackageIndex, fi: FunctionInfo):
+        self.index = index
+        self.fi = fi
+        self.module = fi.module
+        self.types: Dict[str, str] = {}
+        self.conflict: Set[str] = set()
+        self.bindings = self._collect_bindings()
+        for _ in range(3):
+            changed = False
+            for name, expr in self.bindings:
+                dt = self.of(expr)
+                if dt is None or name in self.conflict:
+                    continue
+                cur = self.types.get(name)
+                if cur is None:
+                    self.types[name] = dt
+                    changed = True
+                elif cur != dt:
+                    self.conflict.add(name)
+                    del self.types[name]
+                    changed = True
+            if not changed:
+                break
+
+    def _collect_bindings(self) -> List[Tuple[str, ast.expr]]:
+        out: List[Tuple[str, ast.expr]] = []
+        for node in self.index.shallow_nodes(self.fi):
+            if isinstance(node, ast.Assign) and node.targets:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.append((t.id, node.value))
+                    elif isinstance(t, (ast.Tuple, ast.List)) and \
+                            isinstance(node.value, (ast.Tuple, ast.List)) \
+                            and len(t.elts) == len(node.value.elts):
+                        for te, ve in zip(t.elts, node.value.elts):
+                            if isinstance(te, ast.Name):
+                                out.append((te.id, ve))
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None and \
+                    isinstance(node.target, ast.Name):
+                out.append((node.target.id, node.value))
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                # x += v : promote(x, v) via a synthetic BinOp
+                out.append((node.target.id,
+                            ast.BinOp(left=ast.Name(id=node.target.id,
+                                                    ctx=ast.Load()),
+                                      op=node.op, right=node.value)))
+            elif isinstance(node, ast.NamedExpr) and \
+                    isinstance(node.target, ast.Name):
+                out.append((node.target.id, node.value))
+        return out
+
+    # -- dtype-valued expressions (jnp.float32, "bfloat16", x.dtype) ----
+    def dtype_const(self, node: Optional[ast.expr], depth: int = 0
+                    ) -> Optional[str]:
+        if node is None or depth > 4:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return _DTYPE_NAMES.get(node.value)
+        if isinstance(node, ast.Attribute):
+            if node.attr == "dtype":
+                return self.of(node.value, depth + 1)
+            if node.attr in _DTYPE_NAMES and \
+                    isinstance(node.value, ast.Name):
+                return _DTYPE_NAMES[node.attr]
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in _DTYPE_NAMES:
+                return _DTYPE_NAMES[node.id]
+            # a parameter whose default is a dtype, or a local binding
+            s = self.fi
+            while s is not None:
+                if not isinstance(s.node, ast.Lambda) and \
+                        (node.id in s.param_names()
+                         or node.id in s.kwonly_names()):
+                    return self.dtype_const(s.default_expr(node.id),
+                                            depth + 1)
+                s = s.parent
+            bound = _chase_name(self.index, self.module, self.fi, node.id)
+            if bound is not None and bound is not node:
+                return self.dtype_const(bound, depth + 1)
+            return None
+        if isinstance(node, ast.Call):
+            name = call_target_name(node)
+            if name == "dtype" and node.args:
+                return self.dtype_const(node.args[0], depth + 1)
+            if name == "promote_types" and len(node.args) == 2:
+                return promote(self.dtype_const(node.args[0], depth + 1),
+                               self.dtype_const(node.args[1], depth + 1))
+            if name == "result_type" and node.args:
+                out = None
+                for a in node.args:
+                    d = self.dtype_const(a, depth + 1) or \
+                        self.of(a, depth + 1)
+                    if d is None:
+                        return None
+                    out = d if out is None else promote(out, d)
+                return out
+        return None
+
+    # -- array-expression dtype -----------------------------------------
+    def of(self, node: Optional[ast.expr], depth: int = 0
+           ) -> Optional[str]:
+        if node is None or depth > 6:
+            return None
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return "bool"
+            if isinstance(node.value, float):
+                return WEAK_FLOAT
+            if isinstance(node.value, int):
+                return WEAK_INT
+            return None
+        if isinstance(node, ast.Name):
+            dt = self.types.get(node.id)
+            if dt is not None or node.id in self.conflict:
+                return dt
+            # module-level / default-value Python constants are
+            # weak-typed scalars (N_SHARDS, EPS, ...)
+            bound = _chase_name(self.index, self.module, self.fi,
+                                node.id)
+            v = fold_or_none(bound) if bound is not None else None
+            if isinstance(v, bool) or v is None:
+                return None
+            if isinstance(v, int):
+                return WEAK_INT
+            if isinstance(v, float):
+                return WEAK_FLOAT
+            return None
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("T", "real", "mT"):
+                return self.of(node.value, depth + 1)
+            return None
+        if isinstance(node, ast.Subscript):
+            return self.of(node.value, depth + 1)
+        if isinstance(node, ast.UnaryOp):
+            return self.of(node.operand, depth + 1)
+        if isinstance(node, ast.BinOp):
+            return promote(self.of(node.left, depth + 1),
+                           self.of(node.right, depth + 1))
+        if isinstance(node, ast.Compare):
+            return "bool"
+        if isinstance(node, ast.IfExp):
+            a = self.of(node.body, depth + 1)
+            b = self.of(node.orelse, depth + 1)
+            return a if a == b else None
+        if isinstance(node, ast.Call):
+            return self._call_dtype(node, depth + 1)
+        return None
+
+    def _kw(self, call: ast.Call, name: str) -> Optional[ast.expr]:
+        for kw in call.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _call_dtype(self, call: ast.Call, depth: int) -> Optional[str]:
+        name = call_target_name(call)
+        parts = call_target_parts(call)
+        root = parts[0] if parts else None
+        is_np = root in NUMPY_ROOTS
+        recv = _receiver(call)
+
+        if name == "astype" and call.args:
+            return self.dtype_const(call.args[0], depth)
+        if name == "convert_element_type" and len(call.args) >= 2:
+            return self.dtype_const(call.args[1], depth)
+        if name in ("asarray", "array"):
+            d = self._kw(call, "dtype")
+            if d is None and len(call.args) >= 2:
+                d = call.args[1]
+            if d is not None:
+                return self.dtype_const(d, depth)
+            src = self.of(call.args[0], depth) if call.args else None
+            if src in (WEAK_FLOAT, WEAK_INT) or src is None:
+                if is_np and call.args and _has_float_literal(call.args[0]):
+                    return "float64"      # numpy's default float
+                return src
+            return src
+        if name in _CTOR_CALLS:
+            d = self._kw(call, "dtype")
+            if d is None:
+                idx = {"full": 2}.get(name, 1)
+                if name in ("zeros", "ones", "empty", "full") and \
+                        len(call.args) > idx:
+                    d = call.args[idx]
+            if d is not None:
+                return self.dtype_const(d, depth)
+            if name in ("arange",):
+                return None               # int or float, per args
+            return "float64" if is_np else "float32"
+        if name in _LIKE_CALLS:
+            d = self._kw(call, "dtype")
+            if d is not None:
+                return self.dtype_const(d, depth)
+            return self.of(call.args[0], depth) if call.args else None
+        if name in _MATMUL_CALLS:
+            pet = self._kw(call, "preferred_element_type")
+            if pet is not None:
+                return self.dtype_const(pet, depth)
+            out = None
+            operands = list(call.args)
+            if recv is not None:
+                operands.insert(0, recv)
+            for a in operands:
+                if isinstance(a, ast.Constant):
+                    continue              # einsum spec string
+                d = self.of(a, depth)
+                if d is None:
+                    return None
+                out = d if out is None else promote(out, d)
+            return out
+        if name in _REDUCE_CALLS:
+            d = self._kw(call, "dtype")
+            if d is not None:
+                return self.dtype_const(d, depth)
+            op = recv if recv is not None else \
+                (call.args[0] if call.args else None)
+            return self.of(op, depth)
+        if name in ("float",):
+            return WEAK_FLOAT
+        if name in ("int",):
+            return WEAK_INT
+        if name in _PASSTHROUGH_FIRST:
+            op = recv if recv is not None else \
+                (call.args[0] if call.args else None)
+            return self.of(op, depth)
+        if name in _PASSTHROUGH_JOIN:
+            if name == "where" and len(call.args) >= 3:
+                operands = list(call.args[1:3])
+            elif name in ("concatenate", "stack") and call.args and \
+                    isinstance(call.args[0], (ast.List, ast.Tuple)):
+                operands = list(call.args[0].elts)
+            else:
+                operands = ([recv] if recv is not None else []) + \
+                    [a for a in call.args
+                     if not isinstance(a, ast.Constant)]
+            out = None
+            for a in operands:
+                d = self.of(a, depth)
+                if d is None:
+                    return None
+                out = d if out is None else promote(out, d)
+            return out
+        if recv is not None and name in ("copy", "conj"):
+            return self.of(recv, depth)
+        # one level of local-helper return-dtype resolution
+        callee = self.index.resolve_call(self.module, self.fi, call.func)
+        if callee is not None and depth <= 3:
+            return _return_dtype(self.index, callee)
+        return None
+
+
+def _has_float_literal(node: ast.expr) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and \
+                isinstance(sub.value, float):
+            return True
+    return False
+
+
+def _env_for(index: PackageIndex, fi: FunctionInfo) -> Optional[DtypeEnv]:
+    """Cached per-function DtypeEnv (None while under construction —
+    recursive helper chains stay conservatively unknown)."""
+    cache = getattr(index, "_numerics_envs", None)
+    if cache is None:
+        cache = index._numerics_envs = {}
+    prog = getattr(index, "_numerics_in_progress", None)
+    if prog is None:
+        prog = index._numerics_in_progress = set()
+    key = id(fi.node)
+    if key in cache:
+        return cache[key]
+    if key in prog:
+        return None
+    prog.add(key)
+    try:
+        env = DtypeEnv(index, fi)
+    finally:
+        prog.discard(key)
+    cache[key] = env
+    return env
+
+
+def _return_dtype(index: PackageIndex, fi: FunctionInfo) -> Optional[str]:
+    """Dtype of a helper's single visible return expression."""
+    if isinstance(fi.node, ast.Lambda):
+        env = _env_for(index, fi)
+        return env.of(fi.node.body) if env is not None else None
+    rets = [r.value for r in index.shallow_nodes(fi)
+            if isinstance(r, ast.Return) and r.value is not None]
+    if len(rets) != 1:
+        return None
+    env = _env_for(index, fi)
+    return env.of(rets[0]) if env is not None else None
+
+
+# ---------------------------------------------------------------------------
+# guard detection (max-shift, eps, upcast)
+# ---------------------------------------------------------------------------
+
+def _contains_call(node: ast.expr, names: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                call_target_name(sub) in names:
+            return True
+    return False
+
+
+def _resolve_arg(env: DtypeEnv, node: ast.expr) -> ast.expr:
+    """Chase a Name one step to the expression it was bound to, so a
+    guard applied on the binding line still counts."""
+    if isinstance(node, ast.Name):
+        bound = _chase_name(env.index, env.module, env.fi, node.id)
+        if bound is not None and bound is not node:
+            return bound
+    return node
+
+
+def _is_max_shifted(env: DtypeEnv, arg: ast.expr) -> bool:
+    """``x - max(x)`` (directly or through one binding) — the online /
+    guarded-softmax shift that makes half-precision exp safe."""
+    arg = _resolve_arg(env, arg)
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Sub):
+        rhs = arg.right
+        if _contains_call(rhs, {"max", "amax", "stop_gradient"}):
+            return True
+        if isinstance(rhs, ast.Name):
+            bound = _chase_name(env.index, env.module, env.fi, rhs.id)
+            if bound is not None and \
+                    _contains_call(bound, {"max", "amax"}):
+                return True
+    # exp(-|x|): bounded above by 1, cannot overflow
+    if isinstance(arg, ast.UnaryOp) and isinstance(arg.op, ast.USub) and \
+            _contains_call(arg.operand, {"abs", "absolute"}):
+        return True
+    return _contains_call(arg, {"clip", "minimum"})
+
+
+def _is_eps_guarded(env: DtypeEnv, arg: ast.expr) -> bool:
+    """``log(x + eps)`` / ``log(maximum(x, eps))`` style guards."""
+    arg = _resolve_arg(env, arg)
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, (ast.Add,
+                                                          ast.Sub)):
+        return True
+    return _contains_call(arg, {"maximum", "clip", "where"})
+
+
+def _is_explicit_cast(node: ast.expr) -> bool:
+    return isinstance(node, ast.Call) and \
+        call_target_name(node) in ("astype", "asarray",
+                                   "convert_element_type")
+
+
+# ---------------------------------------------------------------------------
+# per-function rule pass
+# ---------------------------------------------------------------------------
+
+def _check_function(module: ModuleInfo, index: PackageIndex,
+                    fi: FunctionInfo, findings: List[Finding]):
+    env = _env_for(index, fi)
+    if env is None:
+        return
+    ctx = fi.qualname
+    rs_seen: List[Tuple[str, str, ast.Call]] = []   # (axis, dtype, call)
+    ag_seen: List[Tuple[str, str, ast.Call, bool]] = []
+
+    def emit(rule, node, msg):
+        findings.append(Finding(rule, module.relpath, node.lineno,
+                                node.col_offset, msg, ctx))
+
+    for node in index.shallow_nodes(fi):
+        # num-implicit-promotion / num-const-downcast on binary ops
+        if isinstance(node, ast.BinOp) and not isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.BitXor, ast.LShift,
+                          ast.RShift)):
+            a, b = env.of(node.left), env.of(node.right)
+            if a in CONCRETE_FLOATS and b in CONCRETE_FLOATS and \
+                    a != b and (a in HALF_FLOATS or b in HALF_FLOATS):
+                emit("num-implicit-promotion", node,
+                     "binary op mixes %s and %s — relies on silent "
+                     "promotion to %s; cast explicitly (astype) or "
+                     "align the dtypes" % (a, b, promote(a, b)))
+            for side, other in ((node.left, b), (node.right, a)):
+                if other != "float16":
+                    continue
+                v = fold_or_none(side)
+                if isinstance(v, float) and abs(v) > _F16_MAX:
+                    emit("num-const-downcast", node,
+                         "weak literal %g exceeds the float16 finite "
+                         "range (max %g) — the op computes in float16 "
+                         "and overflows to inf" % (v, _F16_MAX))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_target_name(node)
+        parts = call_target_parts(node)
+        recv = _receiver(node)
+
+        # num-const-downcast: explicit float64, or numpy's f64 default
+        dkw = env._kw(node, "dtype")
+        if dkw is not None and env.dtype_const(dkw) == "float64":
+            emit("num-const-downcast", node,
+                 "dtype=float64 under disabled x64 — jax silently "
+                 "downcasts to float32; request float32 (or enable "
+                 "x64) explicitly")
+        elif name == "astype" and node.args and \
+                env.dtype_const(node.args[0]) == "float64":
+            emit("num-const-downcast", node,
+                 "astype(float64) under disabled x64 — jax silently "
+                 "downcasts to float32")
+        elif parts and parts[0] in NUMPY_ROOTS and dkw is None and (
+                (name in ("array", "asarray") and node.args
+                 and _has_float_literal(node.args[0]))
+                or name == "linspace"):
+            emit("num-const-downcast", node,
+                 "numpy %s() defaults to float64 — under disabled x64 "
+                 "the constant is silently downcast when it meets a "
+                 "traced value; pass dtype= explicitly" % name)
+
+        # num-implicit-promotion via jnp binary calls
+        if name in _BINARY_CALLS:
+            operands = node.args[1:3] if name == "where" \
+                else node.args[:2]
+            if len(operands) == 2:
+                a, b = env.of(operands[0]), env.of(operands[1])
+                if a in CONCRETE_FLOATS and b in CONCRETE_FLOATS and \
+                        a != b and (a in HALF_FLOATS or
+                                    b in HALF_FLOATS):
+                    emit("num-implicit-promotion", node,
+                         "%s() mixes %s and %s — relies on silent "
+                         "promotion to %s; cast explicitly"
+                         % (name, a, b, promote(a, b)))
+
+        # num-lowprec-accum: reductions
+        if name in _REDUCE_CALLS:
+            dt = None
+            if dkw is not None:
+                dt = env.dtype_const(dkw)
+            else:
+                op = recv if recv is not None else \
+                    (node.args[0] if node.args else None)
+                dt = env.of(op)
+            if dt in HALF_FLOATS:
+                emit("num-lowprec-accum", node,
+                     "%s() accumulates in %s — pass dtype=jnp.float32 "
+                     "or upcast the operand first" % (name, dt))
+        # num-lowprec-accum: contractions
+        if name in _MATMUL_CALLS and \
+                env._kw(node, "preferred_element_type") is None:
+            operands = ([recv] if recv is not None else []) + \
+                [a for a in node.args
+                 if not isinstance(a, ast.Constant)]
+            dts = [env.of(a) for a in operands]
+            if any(d in HALF_FLOATS for d in dts):
+                emit("num-lowprec-accum", node,
+                     "%s() over %s inputs without "
+                     "preferred_element_type — the MXU accumulator "
+                     "stays low-precision; pass preferred_element_type"
+                     "=jnp.float32" % (name, next(d for d in dts
+                                                  if d in HALF_FLOATS)))
+
+        # num-unstable-exp
+        if name in _EXP_CALLS and node.args:
+            dt = env.of(node.args[0])
+            if dt in HALF_FLOATS and \
+                    not _is_max_shifted(env, node.args[0]):
+                emit("num-unstable-exp", node,
+                     "%s() over %s without a max-shift — half floats "
+                     "overflow/underflow fast; subtract the row max "
+                     "or upcast to float32" % (name, dt))
+        elif name in _LOG_CALLS and node.args:
+            dt = env.of(node.args[0])
+            if dt in HALF_FLOATS and \
+                    not _is_eps_guarded(env, node.args[0]):
+                emit("num-unstable-exp", node,
+                     "%s() over %s without an eps-guard or upcast"
+                     % (name, dt))
+        elif name in _SOFTMAX_CALLS and node.args:
+            dt = env.of(node.args[0])
+            if dt in HALF_FLOATS:
+                emit("num-unstable-exp", node,
+                     "%s() over %s — the normalizer accumulates in "
+                     "%s; upcast to float32 (re-quantize after)"
+                     % (name, dt, dt))
+
+        # num-master-dtype (c): update applied with a half operand
+        if len(node.args) >= 2 and any(
+                isinstance(a, ast.Name) and _is_master_name(a.id)
+                for a in node.args):
+            for a in node.args:
+                if isinstance(a, ast.Name) and _is_master_name(a.id):
+                    continue
+                if env.of(a) in HALF_FLOATS:
+                    emit("num-master-dtype", node,
+                         "update applied to the fp32 master with a %s "
+                         "operand — upcast it to float32 first"
+                         % env.of(a))
+                    break
+
+        # num-master-dtype (a): astype round-trip through a half dtype.
+        # DIRECT syntactic chains only: `m.astype(bf16).astype(f32)` is
+        # an unambiguous precision drop, while upcasting a half value
+        # held in a NAME is the legitimate compute-in-f32 idiom (the fix
+        # the accumulation rule prescribes) and must stay clean.
+        if name == "astype" and node.args and recv is not None:
+            outer = env.dtype_const(node.args[0])
+            inner_call = recv
+            if outer in WIDE_FLOATS and \
+                    isinstance(inner_call, ast.Call) and \
+                    call_target_name(inner_call) == "astype" and \
+                    inner_call.args and \
+                    env.dtype_const(inner_call.args[0]) in HALF_FLOATS:
+                emit("num-master-dtype", node,
+                     "astype round-trip through %s back to %s — the "
+                     "mantissa is already gone; keep the fp32 value "
+                     "live instead" % (
+                         env.dtype_const(inner_call.args[0]), outer))
+
+        # num-collective-dtype bookkeeping
+        if name in _RS_CALLS and node.args:
+            axis = _collective_axis(env, node)
+            dt = env.of(node.args[0])
+            if axis is not None and dt is not None:
+                rs_seen.append((axis, dt, node))
+        elif name in _AG_CALLS and node.args:
+            axis = _collective_axis(env, node)
+            dt = env.of(node.args[0])
+            if axis is not None and dt is not None:
+                ag_seen.append((axis, dt, node,
+                                _is_explicit_cast(node.args[0])))
+
+    # num-master-dtype (b): master-named binding to a half value
+    for bname, bexpr in env.bindings:
+        if _is_master_name(bname) and env.of(bexpr) in HALF_FLOATS:
+            findings.append(Finding(
+                "num-master-dtype", module.relpath, bexpr.lineno,
+                bexpr.col_offset,
+                "fp32 master leaf %r assigned a %s value — the master "
+                "must stay float32 end-to-end (multi_precision "
+                "contract)" % (bname, env.of(bexpr)), ctx))
+
+    # num-collective-dtype: asymmetric pairs over the same axis
+    for ag_axis, ag_dt, ag_node, explicit in ag_seen:
+        if explicit:
+            continue          # intentional conversion (bf16 all-gather)
+        for rs_axis, rs_dt, _rs in rs_seen:
+            if rs_axis == ag_axis and rs_dt != ag_dt:
+                findings.append(Finding(
+                    "num-collective-dtype", module.relpath,
+                    ag_node.lineno, ag_node.col_offset,
+                    "reduce-scatter over axis %r runs in %s but the "
+                    "paired all-gather moves %s — dtype-asymmetric "
+                    "collective pair; make the conversion explicit "
+                    "with astype (ZeRO working-dtype contract)"
+                    % (ag_axis, rs_dt, ag_dt), ctx))
+                break
+
+
+def _collective_axis(env: DtypeEnv, call: ast.Call) -> Optional[str]:
+    """The axis-name string of a collective call (literal, symbol via
+    default/binding), or None when untrackable."""
+    from .sharding import _axis_operand, _resolve_symbol
+    # every _RS_CALLS/_AG_CALLS spelling is in sharding.COLLECTIVES,
+    # which knows each one's axis-operand position
+    cand = _axis_operand(call)
+    if cand is None:
+        return None
+    if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+        return cand.value
+    if isinstance(cand, ast.Name):
+        return _resolve_symbol(env.index, env.module, env.fi, cand.id) \
+            or ("~" + cand.id)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# static dtype flow (the sanitizer cross-check table)
+# ---------------------------------------------------------------------------
+
+def static_dtype_flow(paths: Sequence[str],
+                      root: Optional[str] = None) -> dict:
+    """``{"<relpath>:<qualname>": {var: dtype}}`` — the statically
+    derived dtype of every resolvable local in every jit-reachable
+    function, for the runtime numerics sanitizer's observed-dtype
+    consistency check (``tools.lint.runtime_numerics``), in the PR-6/7
+    static-vs-runtime pattern.  Weak literals are omitted (they carry
+    no committed dtype); conflicted names are omitted (unknown)."""
+    import os
+    from .core import collect_files, ModuleInfo as MI, _repo_root
+
+    root = os.path.abspath(root) if root else _repo_root()
+    modules = []
+    for path in collect_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        try:
+            modules.append(MI(path, rel, src))
+        except SyntaxError:
+            continue
+    index = PackageIndex(modules)
+    flow: Dict[str, Dict[str, str]] = {}
+    for fi in index.functions:
+        if not fi.reachable or isinstance(fi.node, ast.Lambda):
+            continue
+        env = _env_for(index, fi)
+        if env is None:
+            continue
+        table = {n: d for n, d in env.types.items()
+                 if d not in (WEAK_FLOAT, WEAK_INT)}
+        if table:
+            flow["%s:%s" % (fi.module.relpath, fi.qualname)] = table
+    return flow
+
+
+# ---------------------------------------------------------------------------
+
+# cheap textual pre-filter: a module with none of these tokens cannot
+# produce a finding (every rule needs dtype evidence or a collective)
+_TOKENS = ("float16", "bfloat16", "float64", "half", "double",
+           "astype", "preferred_element_type", "reduce_scatter",
+           "all_gather", "master", "linspace", "np.array", "np.asarray",
+           "onp.array", "onp.asarray", "numpy.array")
+
+
+def check(module: ModuleInfo, index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    if not any(t in module.source for t in _TOKENS):
+        return findings
+    for fi in index.functions_in(module):
+        if not fi.reachable or isinstance(fi.node, ast.Lambda):
+            continue
+        _check_function(module, index, fi, findings)
+    return findings

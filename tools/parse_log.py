@@ -83,6 +83,7 @@ def parse_jsonl(lines):
     recompiles = []
     hbm = {}
     lockorder = []
+    numerics = {}
     lint_gate = None
     steps = 0
     for line in lines:
@@ -117,6 +118,21 @@ def parse_jsonl(lines):
             # newly observed acquisition edge — tools.lint.runtime_lockorder)
             lockorder.append({"src": rec.get("src"),
                               "dst": rec.get("dst")})
+        elif kind == "numerics":
+            # runtime numerics sanitizer observations (one event per
+            # leaf first-sighting / dtype change / non-finite count —
+            # tools.lint.runtime_numerics, Monitor nan_guard)
+            leaf = rec.get("leaf", "?")
+            n = numerics.setdefault(leaf, {"dtypes": [], "nonfinite": 0,
+                                           "size": rec.get("size"),
+                                           "first_bad_step": None})
+            dt = rec.get("dtype")
+            if dt and dt not in n["dtypes"]:
+                n["dtypes"].append(dt)
+            bad = int(rec.get("nonfinite") or 0)
+            n["nonfinite"] += bad
+            if bad and n["first_bad_step"] is None:
+                n["first_bad_step"] = rec.get("step")
         elif kind == "lint" and rec.get("name") == "gate":
             lint_gate = rec
         elif kind == "snapshot":
@@ -131,7 +147,8 @@ def parse_jsonl(lines):
         s["total_ms"] = round(s["total_ms"], 4)
     return {"spans": spans, "counters": counters, "gauges": gauges,
             "recompiles": recompiles, "steps": steps, "hbm": hbm,
-            "lockorder": lockorder, "lint_gate": lint_gate}
+            "lockorder": lockorder, "numerics": numerics,
+            "lint_gate": lint_gate}
 
 
 def _render_hbm(hbm, fmt="markdown"):
@@ -193,8 +210,33 @@ def render_jsonl(agg, fmt="markdown"):
                    "(runtime sanitizer):")
         for e in agg["lockorder"]:
             out.append("  %s -> %s" % (e["src"], e["dst"]))
+    out.extend(_render_numerics(agg.get("numerics") or {}, fmt))
     out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
+
+
+def _render_numerics(numerics, fmt="markdown"):
+    """Per-leaf observed-dtype + finite-gauge table from the
+    numerics/observed journal events (runtime numerics sanitizer /
+    Monitor nan_guard)."""
+    if not numerics:
+        return []
+    header = ["leaf", "observed-dtypes", "nonfinite", "size",
+              "first-bad-step"]
+    out = ["", "numerics/observed leaves (runtime sanitizer):"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    for leaf in sorted(numerics):
+        n = numerics[leaf]
+        vals = [leaf, " -> ".join(n["dtypes"]) or "-",
+                str(n["nonfinite"]),
+                "-" if n.get("size") is None else str(n["size"]),
+                "-" if n.get("first_bad_step") is None
+                else str(n["first_bad_step"])]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
 
 
 # rule-id prefix -> checker family (docs/LINTING.md catalog sections;
@@ -202,7 +244,7 @@ def render_jsonl(agg, fmt="markdown"):
 _RULE_FAMILIES = {"trace": "trace-safety", "retrace": "retrace",
                   "donate": "donation", "pallas": "pallas",
                   "shard": "sharding", "conc": "concurrency",
-                  "lint": "meta"}
+                  "num": "numerics", "lint": "meta"}
 
 
 def _rule_family(rule):
