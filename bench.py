@@ -85,17 +85,15 @@ def _step_cost_analysis(step, data, label, step_s=None):
     the number backward-mirror remat shrinks."""
     import jax.numpy as jnp
     from mxnet_tpu import random as _random
+    from mxnet_tpu.tune import search as _search
     jfn = next(iter(step._cache.values())) if step._cache else step._build()
     lrs = jnp.zeros((len(step._trainable),), jnp.float32)
     pvals = [p._data._data for p in step._params]
     lowered = jfn.lower(pvals, step._opt_states, jnp.asarray(1, jnp.int32),
                         lrs, _random.next_key(), data._data, label._data)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    gb = cost.get("bytes accessed", 0.0) / 1e9
-    tf = cost.get("flops", 0.0) / 1e12
+    cost = _search.compiled_cost(lowered)
+    gb = cost["bytes_accessed"] / 1e9
+    tf = cost["flops"] / 1e12
     out = {
         "xla_logical_gb": round(gb, 2),
         "xla_tflops": round(tf, 3),
@@ -107,11 +105,8 @@ def _step_cost_analysis(step, data, label, step_s=None):
         out["hbm_util_upper_capped"] = round(
             min(gb / step_s, PEAK_HBM_BYTES / 1e9) / (PEAK_HBM_BYTES / 1e9),
             3)
-    try:
-        mem = compiled.memory_analysis()
-        out["live_temp_gb"] = round(mem.temp_size_in_bytes / 1e9, 3)
-    except Exception:
-        pass
+    if "temp_bytes" in cost:
+        out["live_temp_gb"] = round(cost["temp_bytes"] / 1e9, 3)
     return out
 
 
@@ -806,12 +801,15 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
     the dense path (the reference's `check_consistency` discipline,
     python/mxnet/test_utils.py:1283, run on the real chip).
     """
+    import os
     import numpy as onp
     import jax
     import jax.numpy as jnp
     from jax import lax
     from mxnet_tpu.ops.pallas_attention import (flash_attention,
-                                                attention_dispatch)
+                                                attention_dispatch,
+                                                tune_attention_blocks)
+    from mxnet_tpu import tune as _tune
 
     rs = onp.random.RandomState(0)
     shape = (batch, heads, seqlen, head_dim)
@@ -853,7 +851,13 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
            "inner_iters": inner, "grads": "q,k,v",
            "kernel": plan["kernel"],
            "block_q": plan["block_q"], "block_k": plan["block_k"],
-           "bwd_kernel": "fused_dqkv" if fused_bwd else "split"}
+           "bwd_kernel": "fused_dqkv" if fused_bwd else "split",
+           # where the blocks came from (table-hit | searched |
+           # heuristic) and which cost table served them — the
+           # artifact-side face of the autotune journal census
+           "tuner_source": plan.get("tuner_source"),
+           "autotune_table": _tune.table_path()
+           if os.path.exists(_tune.table_path()) else None}
     for name, fn in (("flash", flash_attention), ("dense", dense)):
         try:
             loop = mk_loop(fn)
@@ -868,6 +872,48 @@ def bench_attention(batch=8, heads=16, seqlen=2048, head_dim=64, iters=5,
             out[name + "_error"] = repr(e)
     if "flash_ms" in out and "dense_ms" in out:
         out["flash_speedup"] = round(out["dense_ms"] / out["flash_ms"], 2)
+
+    # tuned-vs-heuristic A/B leg: whenever the dispatcher's blocks did
+    # NOT come from the heuristic (table hit / on-miss search), ALSO
+    # time the heuristic config in the SAME run — interleaved
+    # min-of-calls, the ZeRO-bench protocol, so both legs see the same
+    # host contention.  A tuned config slower than the heuristic it
+    # replaced is a HARD failure (_hard_failures): the table's whole
+    # contract is "no shape regresses vs today's clamps".
+    heur_bq, heur_bk = tune_attention_blocks(seqlen, seqlen, head_dim,
+                                             dtype)
+    if plan["kernel"] != "dense_fallback" and \
+            (plan["block_q"], plan["block_k"]) != (heur_bq, heur_bk):
+        from mxnet_tpu.tune import search as _search
+        out["heuristic_config"] = {"block_q": heur_bq, "block_k": heur_bk}
+        try:
+            loop_t, args_t = _search.attention_loop(
+                batch, heads, seqlen, seqlen, head_dim, dtype,
+                {"block_q": plan["block_q"], "block_k": plan["block_k"]},
+                inner=inner)
+            loop_h, args_h = _search.attention_loop(
+                batch, heads, seqlen, seqlen, head_dim, dtype,
+                {"block_q": heur_bq, "block_k": heur_bk}, inner=inner)
+
+            def _one(loop, args):
+                t0 = time.perf_counter()
+                r = loop(*args)
+                float(jnp.asarray(r[0][0, 0, 0, 0]))
+                return (time.perf_counter() - t0) * 1e3 / inner
+            _one(loop_t, args_t)      # compile + warm both legs
+            _one(loop_h, args_h)
+            ms_t = ms_h = None
+            for _ in range(max(2, iters)):
+                d = _one(loop_t, args_t)
+                ms_t = d if ms_t is None else min(ms_t, d)
+                d = _one(loop_h, args_h)
+                ms_h = d if ms_h is None else min(ms_h, d)
+            out["tuned_ms"] = round(ms_t, 3)
+            out["heuristic_ms"] = round(ms_h, 3)
+            out["tuned_vs_heuristic"] = round(ms_h / ms_t, 3)
+            out["tuned_ok"] = ms_t <= ms_h * 1.05
+        except Exception as e:
+            out["ab_error"] = repr(e)[:300]
 
     if check_error and "flash_ms" in out and "dense_ms" in out:
         # on-chip cross-check of the custom kernels vs the dense oracle
@@ -960,6 +1006,9 @@ def main():
         jobs.append(lambda: bench_attention(iters=max(1, args.iters // 4)))
         jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
                                             iters=max(1, args.iters // 4)))
+        jobs.append(lambda: bench_attention(batch=1, heads=8, seqlen=8192,
+                                            iters=max(1, args.iters // 4),
+                                            check_error=False))
         jobs.append(lambda: bench_bert(iters=args.iters, pipelined_k=4))
         jobs.append(lambda: bench_bert(iters=max(2, args.iters // 2),
                                        head="full"))
@@ -1008,6 +1057,14 @@ def main():
         jobs.append(lambda: bench_attention(iters=max(2, it // 4)))
         jobs.append(lambda: bench_attention(batch=2, seqlen=4096,
                                             iters=max(2, it // 4)))
+        # long-seq autotune tail shape (S=8192, streaming kernel): the
+        # ROADMAP item-4 success bar names S=512 and long-seq as the
+        # shapes the cost table must improve; smaller batch/heads so the
+        # dense comparison leg's (B,H,S,S) probabilities fit HBM, and no
+        # dense-oracle error check at this extent
+        jobs.append(lambda: bench_attention(batch=1, heads=8, seqlen=8192,
+                                            iters=max(2, it // 4),
+                                            check_error=False))
         # masked head is the headline (the reference pretraining shape:
         # decode only the 15% masked positions); the full-decode point
         # ships alongside for continuity with r1-r4 artifacts
@@ -1126,6 +1183,11 @@ def _hard_failures(details):
       * ``flash_speedup < 1.0`` at S=512 when a kernel (not the dense
         fallback) was dispatched — the round-5 regression shape; the
         dispatcher exists precisely so this shape never loses to dense;
+      * ``tuned_ok: false`` — a cost-table/searched config measured
+        SLOWER than the heuristic config in the same-run A/B leg; the
+        autotuner's contract is "no shape regresses vs today's clamps",
+        so a regressing table entry fails the run (re-tune or delete
+        the entry);
       * ``telemetry_overhead`` > 2% — the always-on telemetry layer's
         whole contract is that it is too cheap to ever turn off.
     """
@@ -1148,6 +1210,14 @@ def _hard_failures(details):
                 and d["flash_speedup"] < 1.0:
             hard.append("attention S=512 flash_speedup %.2f < 1.0 "
                         "(kernel=%s)" % (d["flash_speedup"], d["kernel"]))
+        if d.get("bench") == "attention" and d.get("tuned_ok") is False:
+            hard.append(
+                "attention %s tuned config (bq=%s, bk=%s, source=%s) "
+                "slower than heuristic %s in the same-run A/B leg "
+                "(%.3f ms vs %.3f ms)" % (
+                    d.get("shape"), d.get("block_q"), d.get("block_k"),
+                    d.get("tuner_source"), d.get("heuristic_config"),
+                    d.get("tuned_ms", 0), d.get("heuristic_ms", 0)))
     return hard
 
 

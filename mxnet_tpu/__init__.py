@@ -72,6 +72,7 @@ from . import visualization as viz
 from . import runtime
 from . import engine
 from . import subgraph
+from . import tune
 from . import attribute
 from . import name
 from .attribute import AttrScope

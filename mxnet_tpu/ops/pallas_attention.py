@@ -93,34 +93,63 @@ def tune_attention_blocks(seq_q, seq_k, head_dim, dtype="bfloat16"):
 
 
 def attention_dispatch(seq_q, seq_k, head_dim, dtype="bfloat16",
-                       on_tpu=None):
+                       on_tpu=None, census=True):
     """Per-shape kernel choice for the public flash-attention ops.
 
     Returns ``{"kernel": "short_seq" | "streaming" | "dense_fallback",
-    "block_q": int | None, "block_k": int | None}``.  ``short_seq`` is
+    "block_q": int | None, "block_k": int | None, "tuner_source":
+    "table" | "searched" | "heuristic" | None}``.  ``short_seq`` is
     the single-pass kernel (whole K axis in one block — no online-softmax
     streaming state), ``streaming`` the K-sequential online-softmax
     kernel, ``dense_fallback`` composed XLA attention.  The heuristic is
     chosen so no caller shape regresses below dense: tiny sequences
     (min(Tq, Tk) < _DENSE_MIN_SEQ) go dense, Tk <= _SHORT_SEQ_MAX_TK
-    single-pass, longer streams.  Chosen blocks always satisfy the VMEM
-    clamp (tune_attention_blocks)."""
+    single-pass, longer streams.
+
+    Blocks come from the autotuner's persistent cost table when it has
+    this (shape, dtype, chip) instance (``mxnet_tpu.tune`` — an
+    on-miss measured search needs the ``MXNET_AUTOTUNE=1`` opt-in;
+    default mode measures nothing), else from the
+    ``tune_attention_blocks`` heuristic.  Either way the chosen blocks
+    satisfy the VMEM clamp — table entries are re-validated against
+    the same ``_fwd_vmem_bytes`` predicate the heuristic honours.
+
+    ``census=False`` is the secondary-lookup spelling (the custom-vjp
+    backward re-reading the forward's decision): same answer, but no
+    counters/journal (the shape was censused at the forward trace) and
+    never an on-miss search — a quiet table lookup only."""
     from .. import telemetry
+    from .. import tune as _tune
     if on_tpu is None:
         on_tpu = _use_pallas()
     if not on_tpu or min(seq_q, seq_k) < _DENSE_MIN_SEQ:
-        telemetry.inc("attention.kernel.dense_fallback")
-        return {"kernel": "dense_fallback", "block_q": None, "block_k": None}
-    block_q, block_k = tune_attention_blocks(seq_q, seq_k, head_dim, dtype)
+        if census:
+            telemetry.inc("attention.kernel.dense_fallback")
+        return {"kernel": "dense_fallback", "block_q": None,
+                "block_k": None, "tuner_source": None}
+    cfg = _tune.table_config("attention",
+                             (int(seq_q), int(seq_k), int(head_dim)),
+                             dtype, quiet=not census)
+    if cfg is not None:
+        block_q, block_k = cfg["block_q"], cfg["block_k"]
+        source = cfg["source"]
+    else:
+        block_q, block_k = tune_attention_blocks(seq_q, seq_k, head_dim,
+                                                 dtype)
+        source = "heuristic"
     kernel = "short_seq" if seq_k <= block_k else "streaming"
     # per-shape dispatch accounting: this runs at TRACE time (once per
     # compiled shape, not per step), so the journal is a census of which
-    # kernel every shape in the run got
-    telemetry.inc("attention.kernel.%s" % kernel)
-    telemetry.event("attention_dispatch", kernel, seq_q=int(seq_q),
-                    seq_k=int(seq_k), head_dim=int(head_dim),
-                    dtype=str(dtype), block_q=block_q, block_k=block_k)
-    return {"kernel": kernel, "block_q": block_q, "block_k": block_k}
+    # kernel every shape in the run got — and of where its blocks came
+    # from (tuner_source)
+    if census:
+        telemetry.inc("attention.kernel.%s" % kernel)
+        telemetry.event("attention_dispatch", kernel, seq_q=int(seq_q),
+                        seq_k=int(seq_k), head_dim=int(head_dim),
+                        dtype=str(dtype), block_q=block_q,
+                        block_k=block_k, tuner_source=source)
+    return {"kernel": kernel, "block_q": block_q, "block_k": block_k,
+            "tuner_source": source}
 
 
 def _compiler_params(pltpu, **kw):
@@ -1299,8 +1328,18 @@ def _flash_fwd(q, k, v, causal, scale, kv_lens, q_segments, kv_segments):
 def _flash_bwd(causal, scale, res, g):
     q, k, v, out, lse, kv_lens, q_segments, kv_segments = res
     if lse is not None:
+        # re-consult the dispatcher (trace-time, deterministic: the
+        # cost-table lookup that served the forward serves the same
+        # blocks here) so tuned configs reach the backward kernels too —
+        # custom_vjp residuals cannot carry static ints, and the A/B
+        # acceptance leg times tuned fwd+bwd together.  census=False:
+        # the shape was counted at the forward trace; this is a quiet
+        # lookup (no double census, never a second search)
+        plan = attention_dispatch(q.shape[2], k.shape[2], q.shape[3],
+                                  q.dtype, census=False)
         dq, dk, dv = pallas_flash_attention_bwd(
             q, k, v, out, lse, g, causal=causal, scale=scale,
+            block_q=plan["block_q"], block_k=plan["block_k"],
             kv_lens=kv_lens, q_segments=q_segments, kv_segments=kv_segments)
     else:
         # recompute-based VJP through the memory-linear jnp path
@@ -1357,8 +1396,13 @@ def _flash_bshd_fwd(q, k, v, causal, scale, kv_lens):
 def _flash_bshd_bwd(causal, scale, res, g):
     q, k, v, out, lse, kv_lens = res
     if lse is not None:
+        # same tuned-block threading as _flash_bwd (BSHD layout: T is
+        # axis 1, D axis 3); census=False — quiet secondary lookup
+        plan = attention_dispatch(q.shape[1], k.shape[1], q.shape[3],
+                                  q.dtype, census=False)
         dq, dk, dv = pallas_flash_attention_bwd_bshd(
             q, k, v, out, lse, g, causal=causal, scale=scale,
+            block_q=plan["block_q"], block_k=plan["block_k"],
             kv_lens=kv_lens)
     else:
         bhtd = lambda x: jnp.swapaxes(x, 1, 2)

@@ -85,9 +85,11 @@ def _pad2d(x, block_r, block_c):
     return x, R + pr, C + pc
 
 
-def _pick_blocks(rows, cols, n_bufs):
-    """(block_r, block_c) whose f32 working set of ``n_bufs`` blocks fits
-    the VMEM budget; None when even the minimum tile does not."""
+def _pick_blocks_heuristic(rows, cols, n_bufs):
+    """Hand-derived (block_r, block_c): the v5e defaults halved until the
+    f32 working set of ``n_bufs`` blocks fits the VMEM budget; None when
+    even the minimum tile does not.  Pure — the autotuner's search
+    anchors on this and its candidates are pruned by the same budget."""
     block_r = min(_BLOCK_ROWS, max(8, -(-rows // 8) * 8))
     block_c = min(_BLOCK_COLS, max(128, -(-cols // 128) * 128))
     while block_r > 8 and block_r * block_c * 4 * n_bufs > _VMEM_BUDGET:
@@ -97,12 +99,40 @@ def _pick_blocks(rows, cols, n_bufs):
     return block_r, block_c
 
 
-def pallas_epilogue_fwd(x2d, s_row, t_row, r2d, interpret=False):
-    """x2d/r2d (R, C); s_row/t_row (1, C) f32 → y (R, C) in x's dtype."""
+def _pick_blocks(rows, cols, n_bufs, quiet=False):
+    """(block_r, block_c) for an instance: the autotuner's cost table
+    when it has this (rows, cols) shape, else the heuristic.  The table
+    key drops ``n_bufs`` — one entry serves fwd (3 bufs) and bwd (5),
+    validated at the conservative 5-buf working set, so both passes run
+    the SAME measured blocks.  ``quiet``: the routing check in
+    ``_fssar_fwd`` censuses the decision ONCE; the fwd/bwd kernel
+    entries re-read it quietly (no double counters, never a second
+    search).  With no table and no ``MXNET_AUTOTUNE`` opt-in this is
+    exactly ``_pick_blocks_heuristic`` (bit-identical default,
+    regression-tested)."""
+    from .. import tune as _tune
+    tuned = _tune.table_blocks("fused_norm", (int(rows), int(cols)),
+                               "float32", quiet=quiet)
+    if tuned is not None:
+        return tuned
+    return _pick_blocks_heuristic(rows, cols, n_bufs)
+
+
+def pallas_epilogue_fwd(x2d, s_row, t_row, r2d, interpret=False,
+                        block_r=None, block_c=None):
+    """x2d/r2d (R, C); s_row/t_row (1, C) f32 → y (R, C) in x's dtype.
+    Explicit ``block_r``/``block_c`` bypass the picker (the autotune
+    search times candidate configs through these)."""
     import jax.experimental.pallas as pl
 
     R, C = x2d.shape
-    block_r, block_c = _pick_blocks(R, C, 3)
+    if block_r is None or block_c is None:
+        block_r, block_c = _pick_blocks(R, C, 3, quiet=True)
+    # clamp to the padded extents (the attention/LN kernels do the
+    # same): an oversize block — a caller's or a stale table's — must
+    # only cost its own tile, never padding R/C up to it
+    block_r = min(block_r, max(8, -(-R // 8) * 8))
+    block_c = min(block_c, max(128, -(-C // 128) * 128))
     xp, Rp, Cp = _pad2d(x2d, block_r, block_c)
     rp, _, _ = _pad2d(r2d, block_r, block_c)
     # scale/shift pad with ZEROS so padded columns emit relu(0) == 0
@@ -124,13 +154,17 @@ def pallas_epilogue_fwd(x2d, s_row, t_row, r2d, interpret=False):
     return y[:R, :C]
 
 
-def pallas_epilogue_bwd(x2d, s_row, y2d, ct2d, interpret=False):
+def pallas_epilogue_bwd(x2d, s_row, y2d, ct2d, interpret=False,
+                        block_r=None, block_c=None):
     """→ (dx (R,C) x-dtype, dr (R,C) x-dtype, ds (1,C) f32, dt (1,C) f32)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     R, C = x2d.shape
-    block_r, block_c = _pick_blocks(R, C, 5)
+    if block_r is None or block_c is None:
+        block_r, block_c = _pick_blocks(R, C, 5, quiet=True)
+    block_r = min(block_r, max(8, -(-R // 8) * 8))
+    block_c = min(block_c, max(128, -(-C // 128) * 128))
     xp, Rp, Cp = _pad2d(x2d, block_r, block_c)
     yp, _, _ = _pad2d(y2d, block_r, block_c)
     # padded cotangent rows/cols are zero → no dx/dr/ds/dt contribution

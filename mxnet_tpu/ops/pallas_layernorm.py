@@ -179,12 +179,32 @@ def _use_pallas():
 _VMEM_BUDGET = 6 * 1024 * 1024
 
 
-def _pick_block_rows(C):
+def _pick_block_rows_heuristic(C):
     """Largest multiple-of-8 row block whose bwd working set fits the
-    VMEM budget; None when even 8 rows do not fit (fall back to XLA)."""
+    VMEM budget; None when even 8 rows do not fit (fall back to XLA).
+    Pure — the autotuner's search anchors on this and its candidates
+    are pruned by the same budget."""
     rows = _VMEM_BUDGET // (3 * 4 * C)
     rows = min(_BLOCK_ROWS, (rows // 8) * 8)
     return rows if rows >= 8 else None
+
+
+def _pick_block_rows(C, rows, quiet=False):
+    """Row block for an instance: the autotuner's cost table when it has
+    this (rows, C) shape (validated against the same VMEM budget), else
+    the heuristic.  ``rows`` is required — it is half the table key; a
+    defaulted placeholder would silently look up a shape no tuning run
+    ever records.  ``quiet``: the forward censuses the decision once,
+    the backward re-reads it quietly.  With no table and no
+    ``MXNET_AUTOTUNE`` opt-in this is exactly
+    ``_pick_block_rows_heuristic`` (bit-identical default,
+    regression-tested)."""
+    from .. import tune as _tune
+    tuned = _tune.table_blocks("layernorm", (int(rows), int(C)),
+                               "float32", quiet=quiet)
+    if tuned is not None:
+        return tuned
+    return _pick_block_rows_heuristic(C)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -213,7 +233,7 @@ def _jnp_ln(data, gamma, beta, eps):
 
 def _fln_fwd(data, gamma, beta, eps):
     C = data.shape[-1]
-    block = _pick_block_rows(C)
+    block = _pick_block_rows(C, rows=data.size // C)
     if not _use_pallas() or block is None:
         out = _jnp_ln(data, gamma, beta, eps)
         return out, (data, gamma, beta, None, None)
@@ -234,7 +254,7 @@ def _fln_bwd(eps, res, ct):
         return vjp(ct)
     dx2, dg, db = pallas_layer_norm_bwd(
         data.reshape(-1, C), gamma, mu, rstd, ct.reshape(-1, C),
-        block_rows=_pick_block_rows(C))
+        block_rows=_pick_block_rows(C, rows=data.size // C, quiet=True))
     return (dx2.reshape(shape), dg.astype(gamma.dtype),
             db.astype(beta.dtype))
 

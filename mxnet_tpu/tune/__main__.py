@@ -1,0 +1,106 @@
+"""Offline autotuning CLI.
+
+    python -m mxnet_tpu.tune --family attention --shape 512:512:64 \
+        --shape 8192:8192:64 --dtype bfloat16
+    python -m mxnet_tpu.tune --family layernorm --shape 16384:1024
+    python -m mxnet_tpu.tune --list
+
+Searches each instance with the same driver the on-miss dispatch path
+uses (wider default budget — offline time is cheap) and persists the
+winners to the cost table, one JSON result line per instance.  Shapes
+are colon-separated per family: attention ``seq_q:seq_k:head_dim``,
+fused_norm ``rows:cols``, layernorm ``rows:channels`` (the norm
+families key dtype-blind — their VMEM working sets are fp32 whatever
+the operand dtype — so ``--dtype`` only picks the measurement
+operands).  ``--interpret`` runs the kernels in Pallas interpret mode
+so a table can be exercised end-to-end off-TPU (functional, not
+representative — never ship interpret-mode timings as a real chip's
+table).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import get_table, platform_id, search
+from .cost_table import FAMILY_FIELDS
+
+_SHAPE_ARITY = {"attention": 3, "fused_norm": 2, "layernorm": 2}
+
+
+def _parse_shape(family, text):
+    parts = tuple(int(x) for x in text.split(":"))
+    if len(parts) != _SHAPE_ARITY[family]:
+        raise SystemExit("--shape %s: %s expects %d ints"
+                         % (text, family, _SHAPE_ARITY[family]))
+    return parts
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m mxnet_tpu.tune")
+    ap.add_argument("--family", choices=sorted(FAMILY_FIELDS),
+                    default="attention")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="instance shape, colon-separated (repeatable)")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--trials", type=int, default=32,
+                    help="max candidates timed per instance (offline "
+                         "default is wide; dispatch-time uses "
+                         "MXNET_AUTOTUNE_TRIALS)")
+    ap.add_argument("--calls", type=int, default=search.DEFAULT_CALLS)
+    ap.add_argument("--interpret", action="store_true",
+                    help="Pallas interpret mode (off-TPU smoke runs)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="search but do not write the table")
+    ap.add_argument("--table", default=None,
+                    help="table path override (else MXNET_AUTOTUNE_TABLE "
+                         "or the repo default)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the table's entries and exit")
+    args = ap.parse_args(argv)
+
+    table = get_table()
+    if args.table:
+        from .cost_table import CostTable
+        table = CostTable(args.table)
+    if args.list:
+        for rec in table.entries():
+            print(json.dumps(rec))
+        return 0
+    if not args.shape:
+        ap.error("at least one --shape is required (or --list)")
+
+    rc = 0
+    for text in args.shape:
+        shape = _parse_shape(args.family, text)
+        res = search.search_config(args.family, shape, args.dtype,
+                                   trials=args.trials, calls=args.calls,
+                                   interpret=args.interpret)
+        line = {"family": args.family, "shape": list(shape),
+                "dtype": args.dtype, "platform": platform_id(),
+                "table": table.path}
+        if res is None:
+            line["error"] = "no candidate could be timed"
+            rc = 1
+        else:
+            line.update(config=res["config"],
+                        best_ms=round(res["best_ms"], 6),
+                        trials=res["trials"], space=res["space"],
+                        results=res["results"])
+            if args.family == "attention":
+                line["kernel"] = search.attention_variant(
+                    shape[1], res["config"]["block_k"])
+            if not args.dry_run:
+                # interpret provenance is stamped into the record:
+                # lookup refuses interpret-timed configs on a real chip
+                table.record(args.family, shape, args.dtype,
+                             res["config"], best_ms=res["best_ms"],
+                             source="offline", trials=res["trials"],
+                             interpret=args.interpret)
+        print(json.dumps(line), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

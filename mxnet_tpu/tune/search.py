@@ -1,0 +1,449 @@
+"""Measured search over Pallas kernel configs (the TVM recipe, arxiv
+1802.04799: enumerate a small schedule space, prune statically, time
+the survivors, persist the winner).
+
+This module is THE timing harness for kernel tuning — ``bench.py``'s
+attention A/B leg and ``tools/attn_probe.py`` are thin layers over it,
+and the offline CLI (``python -m mxnet_tpu.tune``) and the on-miss
+dispatch search both call :func:`search_config`.
+
+Candidate pruning REUSES the kernels' own sizing arithmetic —
+``_fwd_vmem_bytes``/``_VMEM_CLAMP`` from ``ops/pallas_attention`` and
+the ``_VMEM_BUDGET`` constants from the norm modules — the exact
+expressions graftlint's static pallas estimator folds, so no invalid
+candidate is ever timed and the static rule rejects anything the
+search could not have emitted.
+
+Determinism contract: candidate order is a pure function of the
+instance, timing is injectable (``timer=``/``measure=``), and ties go
+to the earliest candidate — a fake timer makes the whole search
+reproducible bit-for-bit (tested).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["min_time", "fwd_bwd_loop", "candidates", "heuristic_config",
+           "valid_config", "search_config", "measure_attention_config",
+           "attention_loop", "compiled_cost"]
+
+# dispatch-time (on-miss) search budget: at most this many candidates
+# are ever timed per instance unless the caller widens it
+DEFAULT_TRIALS = 6
+DEFAULT_CALLS = 3        # min-of-K measured calls per candidate
+DEFAULT_WARMUP = 1       # discarded compile+warmup calls per candidate
+
+# synthetic operand sizes for the attention measurement (enough rows to
+# fill the grid; the offline CLI can override)
+_ATTN_BATCH = 4
+_ATTN_HEADS = 8
+_ATTN_INNER = 4          # chained fwd+bwd iterations inside one jit
+
+_BQ_CANDIDATES = (128, 256, 512, 1024, 2048)
+_BK_CANDIDATES = (128, 256, 512, 1024, 2048)
+# the fused-norm bwd holds 5 f32 blocks (the fwd 3): one table entry per
+# (rows, cols) serves both passes, sized at the conservative bwd set
+_NORM_N_BUFS = 5
+_BR_CANDIDATES = (8, 16, 32, 64, 128, 256, 512)
+_BC_CANDIDATES = (128, 256, 512, 1024)
+_LN_ROW_CANDIDATES = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def _block_ready(x):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+def min_time(fn: Callable[[], object], calls: int = DEFAULT_CALLS,
+             warmup: int = DEFAULT_WARMUP,
+             timer: Optional[Callable[[], float]] = None) -> float:
+    """Min-of-``calls`` seconds for ``fn()`` bounded by block_until_ready,
+    after ``warmup`` discarded calls (compile + cache warm).  ``timer``
+    is injectable for deterministic tests."""
+    timer = timer or time.perf_counter
+    for _ in range(warmup):
+        _block_ready(fn())
+    best = None
+    for _ in range(max(1, calls)):
+        t0 = timer()
+        _block_ready(fn())
+        dt = timer() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def fwd_bwd_loop(fn, inner: int):
+    """Jitted loop running ``inner`` chained fwd+bwd iterations of
+    ``fn(q, k, v)`` (grads w.r.t. all three operands, data dependence
+    between iterations) — kernel time, not dispatch time.  The one
+    loop-builder shared by the search, bench.py's A/B leg and
+    tools/attn_probe.py."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    grad = jax.grad(lambda q, k, v:
+                    jnp.sum(fn(q, k, v).astype(jnp.float32)),
+                    argnums=(0, 1, 2))
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(_, qkv):
+            q, k, v = qkv
+            dq, dk, dv = grad(q, k, v)
+            return (q + 0 * dq, k + 0 * dk, v + 0 * dv)
+        return lax.fori_loop(0, inner, body, (q, k, v))
+    return loop
+
+
+def _rup(x: int, m: int) -> int:
+    return x + (-x) % m
+
+
+def _log2(x: int) -> float:
+    import math
+    return math.log2(x)
+
+
+# ---------------------------------------------------------------------------
+# candidate spaces (heuristic config always first, order deterministic)
+# ---------------------------------------------------------------------------
+
+def heuristic_config(family: str, shape: Sequence[int],
+                     dtype) -> Optional[Dict[str, int]]:
+    """Today's hand-derived clamp config for an instance — the fallback
+    the tuned config is benched against, always candidate #0."""
+    if family == "attention":
+        from ..ops.pallas_attention import tune_attention_blocks
+        seq_q, seq_k, head_dim = shape
+        bq, bk = tune_attention_blocks(seq_q, seq_k, head_dim, dtype)
+        return {"block_q": bq, "block_k": bk}
+    if family == "fused_norm":
+        from ..ops.pallas_fused_norm import _pick_blocks_heuristic
+        rows, cols = shape
+        # fwd holds 3 f32 blocks, bwd 5; ONE (rows, cols) table entry
+        # serves both, so size at the conservative bwd working set
+        picked = _pick_blocks_heuristic(rows, cols, _NORM_N_BUFS)
+        if picked is None:
+            return None
+        return {"block_r": picked[0], "block_c": picked[1]}
+    if family == "layernorm":
+        from ..ops.pallas_layernorm import _pick_block_rows_heuristic
+        rows, C = shape
+        block = _pick_block_rows_heuristic(C)
+        if block is None:
+            return None
+        return {"block_rows": block}
+    raise ValueError("unknown kernel family %r" % (family,))
+
+
+def valid_config(family: str, shape: Sequence[int], dtype,
+                 config: Dict[str, int]) -> bool:
+    """The kernels' own VMEM/clamp predicate — the same arithmetic the
+    graftlint pallas estimator checks statically.  Table entries and
+    search candidates both pass through here; an invalid config is a
+    heuristic fallback, never a compile attempt."""
+    try:
+        if family == "attention":
+            import jax.numpy as jnp
+            from ..ops.pallas_attention import (_fwd_vmem_bytes,
+                                                _VMEM_CLAMP, _LANES)
+            seq_q, seq_k, head_dim = shape
+            bq, bk = int(config["block_q"]), int(config["block_k"])
+            # sublane (8) / lane (128) alignment: Mosaic rejects
+            # misaligned blocks at compile, so a hand-edited table
+            # entry must fail HERE, not in the training job
+            if bq < 8 or bq % 8 or bk < _LANES or bk % _LANES:
+                return False
+            Dp = head_dim + (-head_dim) % 64
+            itemsize = jnp.dtype(dtype).itemsize
+            return _fwd_vmem_bytes(bq, bk, Dp, itemsize) <= _VMEM_CLAMP
+        if family == "fused_norm":
+            from ..ops.pallas_fused_norm import _VMEM_BUDGET
+            br, bc = int(config["block_r"]), int(config["block_c"])
+            return br >= 8 and br % 8 == 0 and bc >= 128 \
+                and bc % 128 == 0 \
+                and br * bc * 4 * _NORM_N_BUFS <= _VMEM_BUDGET
+        if family == "layernorm":
+            from ..ops.pallas_layernorm import _VMEM_BUDGET
+            rows, C = shape
+            b = int(config["block_rows"])
+            return b >= 8 and b % 8 == 0 and 3 * 4 * b * C <= _VMEM_BUDGET
+    except (KeyError, TypeError, ValueError):
+        return False
+    return False
+
+
+def candidates(family: str, shape: Sequence[int],
+               dtype) -> List[Dict[str, int]]:
+    """Pruned candidate configs: the heuristic first, then the grid
+    ordered by log-distance FROM the heuristic (ties by field values —
+    fully deterministic).  The ordering is what makes a small trial
+    budget meaningful: truncating to N keeps the heuristic's
+    neighbourhood, not one corner of the grid.  Block sizes are clamped
+    to the padded instance extents (a block larger than the axis only
+    buys padding) and every survivor already honours the VMEM
+    predicate."""
+    heur = heuristic_config(family, shape, dtype)
+    out: List[Dict[str, int]] = []
+    seen = set()
+
+    def add(cfg):
+        if cfg is None:
+            return
+        key = tuple(sorted(cfg.items()))
+        if key in seen or not valid_config(family, shape, dtype, cfg):
+            return
+        seen.add(key)
+        out.append(cfg)
+
+    def _log_dist(cfg):
+        # halvings/doublings away from the heuristic across all fields
+        if heur is None:
+            return 0.0
+        d = 0.0
+        for f, v in cfg.items():
+            h = heur.get(f, v)
+            d += abs(_log2(max(1, int(v))) - _log2(max(1, int(h))))
+        return d
+
+    add(heur)
+    grid: List[Dict[str, int]] = []
+    if family == "attention":
+        from ..ops.pallas_attention import _LANES
+        seq_q, seq_k, _ = shape
+        bqs = sorted({min(b, max(8, _rup(seq_q, 8)))
+                      for b in _BQ_CANDIDATES})
+        bks = sorted({min(b, _rup(seq_k, _LANES)) for b in _BK_CANDIDATES}
+                     | {_rup(seq_k, _LANES)})
+        grid = [{"block_q": bq, "block_k": bk}
+                for bq in bqs for bk in bks]
+    elif family == "fused_norm":
+        rows, cols = shape
+        brs = sorted({min(b, max(8, _rup(rows, 8)))
+                      for b in _BR_CANDIDATES})
+        bcs = sorted({min(b, max(128, _rup(cols, 128)))
+                      for b in _BC_CANDIDATES})
+        grid = [{"block_r": br, "block_c": bc}
+                for br in brs for bc in bcs]
+    elif family == "layernorm":
+        rows, _ = shape
+        grid = [{"block_rows": b}
+                for b in sorted({min(b, max(8, _rup(rows, 8)))
+                                 for b in _LN_ROW_CANDIDATES})]
+    else:
+        raise ValueError("unknown kernel family %r" % (family,))
+    for cfg in sorted(grid, key=lambda c: (_log_dist(c),
+                                           tuple(sorted(c.items())))):
+        add(cfg)
+    return out
+
+
+def attention_variant(seq_k: int, block_k: int) -> str:
+    """Which forward kernel a (seq_k, block_k) pair routes to — the
+    same rule attention_dispatch applies."""
+    return "short_seq" if seq_k <= block_k else "streaming"
+
+
+# ---------------------------------------------------------------------------
+# measurement (per family)
+# ---------------------------------------------------------------------------
+
+def _rand_operands(shapes, dtype, seed=0):
+    import numpy as onp
+    import jax.numpy as jnp
+    rs = onp.random.RandomState(seed)
+    return tuple(jnp.asarray(rs.uniform(-1, 1, s).astype("float32"),
+                             jnp.dtype(dtype)) for s in shapes)
+
+
+def attention_loop(batch, heads, seq_q, seq_k, head_dim, dtype, config,
+                   causal=False, inner=_ATTN_INNER, interpret=False):
+    """(jitted fwd+bwd loop, (q, k, v)) for one explicit attention
+    config — the flash kernels with ``config``'s blocks wired through a
+    local custom_vjp so the default-block wrapper never re-tunes."""
+    from ..ops import pallas_attention as pa
+    import jax
+
+    bq, bk = int(config["block_q"]), int(config["block_k"])
+
+    @jax.custom_vjp
+    def att(q, k, v):
+        return pa.pallas_flash_attention(q, k, v, causal=causal,
+                                         block_q=bq, block_k=bk,
+                                         interpret=interpret)
+
+    def att_fwd(q, k, v):
+        out, lse = pa.pallas_flash_attention(q, k, v, causal=causal,
+                                             block_q=bq, block_k=bk,
+                                             interpret=interpret,
+                                             return_lse=True)
+        return out, (q, k, v, out, lse)
+
+    def att_bwd(res, g):
+        q, k, v, out, lse = res
+        return pa.pallas_flash_attention_bwd(q, k, v, out, lse, g,
+                                             causal=causal, block_q=bq,
+                                             block_k=bk,
+                                             interpret=interpret)
+
+    att.defvjp(att_fwd, att_bwd)
+    q, k, v = _rand_operands(((batch, heads, seq_q, head_dim),
+                              (batch, heads, seq_k, head_dim),
+                              (batch, heads, seq_k, head_dim)), dtype)
+    return fwd_bwd_loop(att, inner), (q, k, v)
+
+
+def measure_attention_config(batch, heads, seq_q, seq_k, head_dim, dtype,
+                             config, causal=False, inner=_ATTN_INNER,
+                             calls=DEFAULT_CALLS, warmup=DEFAULT_WARMUP,
+                             timer=None, interpret=False):
+    """Seconds per fwd+bwd iteration for one explicit config (min-of-
+    ``calls``, ``inner`` chained iterations amortize dispatch)."""
+    loop, args = attention_loop(batch, heads, seq_q, seq_k, head_dim,
+                                dtype, config, causal=causal, inner=inner,
+                                interpret=interpret)
+    return min_time(lambda: loop(*args), calls=calls, warmup=warmup,
+                    timer=timer) / max(1, inner)
+
+
+def _measure_fused_norm(shape, dtype, config, calls, warmup, timer,
+                        interpret):
+    import jax
+    from ..ops import pallas_fused_norm as fn
+
+    rows, cols = shape
+    br, bc = int(config["block_r"]), int(config["block_c"])
+    x, r, ct = _rand_operands(((rows, cols),) * 3, dtype)
+    s, t = _rand_operands(((1, cols),) * 2, "float32", seed=1)
+
+    @jax.jit
+    def step(x, s, t, r, ct):
+        y = fn.pallas_epilogue_fwd(x, s, t, r, block_r=br, block_c=bc,
+                                   interpret=interpret)
+        dx, dr, ds, dt = fn.pallas_epilogue_bwd(x, s, y, ct, block_r=br,
+                                                block_c=bc,
+                                                interpret=interpret)
+        return y, dx, dr, ds, dt
+
+    return min_time(lambda: step(x, s, t, r, ct), calls=calls,
+                    warmup=warmup, timer=timer)
+
+
+def _measure_layernorm(shape, dtype, config, calls, warmup, timer,
+                       interpret):
+    import jax
+    from ..ops import pallas_layernorm as ln
+
+    rows, C = shape
+    block = int(config["block_rows"])
+    x, ct = _rand_operands(((rows, C),) * 2, dtype)
+    g, b = _rand_operands(((C,),) * 2, "float32", seed=1)
+
+    @jax.jit
+    def step(x, g, b, ct):
+        y, mu, rstd = ln.pallas_layer_norm_fwd(x, g, b, 1e-5,
+                                               block_rows=block,
+                                               interpret=interpret)
+        dx, dg, db = ln.pallas_layer_norm_bwd(x, g, mu, rstd, ct,
+                                              block_rows=block,
+                                              interpret=interpret)
+        return y, dx, dg, db
+
+    return min_time(lambda: step(x, g, b, ct), calls=calls,
+                    warmup=warmup, timer=timer)
+
+
+def _measure_candidate(family, shape, dtype, config, calls=DEFAULT_CALLS,
+                       warmup=DEFAULT_WARMUP, timer=None,
+                       interpret=False):
+    """Milliseconds for one candidate (module-level so tests can inject
+    a fake).  Attention reports per-inner-iteration time; the norm
+    families a full fwd+bwd pass."""
+    if family == "attention":
+        seq_q, seq_k, head_dim = shape
+        s = measure_attention_config(_ATTN_BATCH, _ATTN_HEADS, seq_q,
+                                     seq_k, head_dim, dtype, config,
+                                     calls=calls, warmup=warmup,
+                                     timer=timer, interpret=interpret)
+    elif family == "fused_norm":
+        s = _measure_fused_norm(shape, dtype, config, calls, warmup,
+                                timer, interpret)
+    elif family == "layernorm":
+        s = _measure_layernorm(shape, dtype, config, calls, warmup,
+                               timer, interpret)
+    else:
+        raise ValueError("unknown kernel family %r" % (family,))
+    return s * 1000.0
+
+
+def search_config(family, shape, dtype, trials=DEFAULT_TRIALS,
+                  calls=DEFAULT_CALLS, warmup=DEFAULT_WARMUP, timer=None,
+                  measure=None, interpret=False):
+    """Measured search for one instance.
+
+    Enumerates :func:`candidates` (heuristic first), keeps the first
+    ``trials`` (the STRICT budget for on-miss dispatch search), times
+    each with min-of-``calls``, and returns::
+
+        {"config": best, "best_ms": float, "source": "searched",
+         "trials": n_actually_timed, "space": n_enumerated,
+         "interpret": bool, "results": [...]}
+
+    or None when nothing could be timed.  ``measure`` overrides the
+    per-candidate measurement (tests); ``timer`` reaches the real
+    measurement's clock.  Ties go to the earliest candidate, so a
+    deterministic measure makes the search deterministic."""
+    cands = candidates(family, shape, dtype)
+    if not cands:
+        return None
+    space = len(cands)
+    if trials is not None:
+        cands = cands[:max(1, int(trials))]
+    measure = measure or (lambda cfg: _measure_candidate(
+        family, shape, dtype, cfg, calls=calls, warmup=warmup,
+        timer=timer, interpret=interpret))
+    results = []
+    best = None
+    for cfg in cands:
+        try:
+            ms = float(measure(cfg))
+        except Exception as e:     # a candidate that fails to compile
+            results.append({"config": cfg, "error": repr(e)[:200]})
+            continue
+        results.append({"config": cfg, "ms": round(ms, 6)})
+        if best is None or ms < best[1]:
+            best = (cfg, ms)
+    if best is None:
+        return None
+    return {"config": dict(best[0]), "best_ms": best[1],
+            "source": "searched",
+            "trials": sum(1 for r in results if "ms" in r),
+            "space": space, "interpret": bool(interpret),
+            "results": results}
+
+
+# ---------------------------------------------------------------------------
+# XLA cost analysis (shared by bench._step_cost_analysis / cost_probe)
+# ---------------------------------------------------------------------------
+
+def compiled_cost(lowered):
+    """Compile a lowered jit computation and return its XLA cost
+    analysis as ``{"flops", "bytes_accessed"[, "temp_bytes"]}`` —
+    the one place that knows about the list-wrapped cost dict and the
+    optional memory analysis."""
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    out = {"flops": float(cost.get("flops", 0.0)),
+           "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+    try:
+        out["temp_bytes"] = int(compiled.memory_analysis()
+                                .temp_size_in_bytes)
+    except Exception:
+        pass
+    return out

@@ -1,0 +1,46 @@
+"""Tune-table lookup fixture: blocks that arrive via the autotuner's
+cost table (``mxnet_tpu.tune.table_blocks``) instead of a literal clamp
+chain.  The pallas checker resolves the lookup's ``default=`` fallback
+config, so the static VMEM rule still rejects an over-budget candidate
+config the search space could otherwise declare — and the pristine twin
+with an in-budget config stays clean (proving the resolution happened:
+without it the stale module defaults would false-positive the twin)."""
+import jax
+import jax.numpy as jnp
+import jax.experimental.pallas as pl
+
+from mxnet_tpu.tune import table_blocks
+
+_VMEM_CLAMP = 12 * 1024 * 1024
+
+
+def _k(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def over_budget_candidate(x):
+    # a (4096, 4096) score-shaped candidate: 32 MiB in + 32 MiB out
+    # blocks blow the 12 MiB clamp long before the score tile
+    block_q, block_k = table_blocks("attention", (32768, 4096, 128),
+                                    "bfloat16", default=(4096, 4096))
+    return pl.pallas_call(  # expect: pallas-vmem-budget
+        _k,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((32768, 4096), jnp.bfloat16)],
+    )(x)
+
+
+def in_budget_candidate(x):
+    # pristine twin: same lookup shape, in-budget fallback config
+    # (1 MiB in + 1 MiB out + 2 MiB score tile) — must stay clean
+    block_q, block_k = table_blocks("attention", (32768, 4096, 128),
+                                    "bfloat16", default=(512, 1024))
+    return pl.pallas_call(
+        _k,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((32768, 4096), jnp.bfloat16)],
+    )(x)

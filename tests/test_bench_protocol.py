@@ -124,13 +124,35 @@ def test_hard_failures_gate_telemetry_overhead(bench):
 
 def test_attention_bench_records_dispatcher_choice(bench):
     """The attention sweep ships the dispatcher's kernel choice (and its
-    block tuning) per shape so BENCH rounds can audit dispatch."""
+    block tuning + tuner provenance) per shape so BENCH rounds can audit
+    dispatch."""
     out = bench.bench_attention(batch=1, heads=1, seqlen=64, head_dim=8,
                                 iters=1, inner=1, check_error=False)
     assert out["kernel"] in ("short_seq", "streaming", "dense_fallback")
     # this suite runs on CPU: the public op must have routed dense
     assert out["kernel"] == "dense_fallback"
     assert "block_q" in out and "block_k" in out
+    # autotune provenance fields always ship (None when dense/no table)
+    assert "tuner_source" in out and "autotune_table" in out
+
+
+def test_hard_failures_gate_tuned_vs_heuristic(bench):
+    """A cost-table config measured slower than the heuristic config in
+    the same-run A/B leg is a hard bench failure — the autotuner's
+    no-regression contract."""
+    bad = {"bench": "attention", "shape": [8, 16, 512, 64],
+           "kernel": "short_seq", "flash_speedup": 1.4, "max_err_ok": True,
+           "tuner_source": "table", "block_q": 128, "block_k": 512,
+           "heuristic_config": {"block_q": 512, "block_k": 512},
+           "tuned_ms": 2.2, "heuristic_ms": 2.0, "tuned_ok": False}
+    assert any("slower than heuristic" in h
+               for h in bench._hard_failures([bad]))
+    assert not bench._hard_failures([dict(bad, tuned_ok=True)])
+    # no A/B leg ran (heuristic dispatch): nothing to gate
+    no_ab = {"bench": "attention", "shape": [8, 16, 512, 64],
+             "kernel": "short_seq", "flash_speedup": 1.4,
+             "max_err_ok": True, "tuner_source": "heuristic"}
+    assert not bench._hard_failures([no_ab])
 
 
 def test_sanity_gate_flags_regression_vs_history(bench, tmp_path,

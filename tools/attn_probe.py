@@ -1,35 +1,22 @@
 #!/usr/bin/env python
-"""Flash-attention block-size sweep on the real chip (scratch tool for
-kernel tuning; winners land in ops/pallas_attention.py defaults).
-
-Times FULL fwd+bwd (grads w.r.t. q,k,v) with ``inner`` chained
-iterations inside one jit, the same protocol as bench.py's
-bench_attention, across (block_q, block_k) combos.  Optionally times
-jax's own shipped TPU flash kernel as an expert-tuned upper bound.
+"""Flash-attention block-size sweep on the real chip — a thin wrapper
+over the autotune search driver (``mxnet_tpu.tune.search``), which owns
+the ONE timing harness (jitted chained fwd+bwd loop, min-of-K calls
+bounded by block_until_ready).  Winners belong in the persistent cost
+table (``python -m mxnet_tpu.tune``), not in code edits; this probe
+remains for quick manual sweeps and for timing jax's own shipped TPU
+flash kernel as an expert-tuned upper bound.
 
     python tools/attn_probe.py --seqlen 2048
     python tools/attn_probe.py --seqlen 512 --blocks 512:512,256:512
     python tools/attn_probe.py --jax-reference
 """
 import argparse
-import functools
 import json
 import os
 import sys
-import time
-
-import numpy as onp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def time_loop(loop, q, k, v, sync, iters=3):
-    sync(loop(q, k, v))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = loop(q, k, v)
-    sync(out)
-    return (time.perf_counter() - t0) / iters
 
 
 def main():
@@ -39,7 +26,8 @@ def main():
     ap.add_argument("--seqlen", type=int, default=2048)
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--inner", type=int, default=10)
-    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=3,
+                    help="timed calls per config (min-of-K)")
     ap.add_argument("--causal", action="store_true")
     ap.add_argument("--blocks", default="1024:2048,512:2048,2048:1024,"
                     "512:1024,1024:1024,256:2048,2048:512")
@@ -48,74 +36,24 @@ def main():
                     "flash_attention")
     args = ap.parse_args()
 
-    import jax
-    import jax.numpy as jnp
+    from mxnet_tpu.tune import search
 
-    rs = onp.random.RandomState(0)
-    shape = (args.batch, args.heads, args.seqlen, args.head_dim)
-    q, k, v = (jnp.asarray(rs.uniform(-1, 1, shape).astype("float32"),
-                           jnp.bfloat16) for _ in range(3))
     S, D = args.seqlen, args.head_dim
     # fwd 4*S^2*D per head, bwd ~2.5x (flash recompute), per bench.py
-    flops = args.inner * 3.5 * 4 * S * S * D * args.batch * args.heads
+    flops = 3.5 * 4 * S * S * D * args.batch * args.heads
     if args.causal:
         flops /= 2
 
-    def sync(t):
-        onp.asarray(jax.tree_util.tree_leaves(t)[0].ravel()[:1])
-
-    def mk_loop(fn):
-        grad = jax.grad(lambda q, k, v:
-                        jnp.sum(fn(q, k, v).astype(jnp.float32)),
-                        argnums=(0, 1, 2))
-
-        @jax.jit
-        def loop(q, k, v):
-            def body(_, qkv):
-                q, k, v = qkv
-                dq, dk, dv = grad(q, k, v)
-                return (q + 0 * dq, k + 0 * dk, v + 0 * dv)
-            return jax.lax.fori_loop(0, args.inner, body, (q, k, v))
-        return loop
-
     for spec in args.blocks.split(","):
         bq, bk = (int(x) for x in spec.split(":"))
-        from mxnet_tpu.ops import pallas_attention as pa
-
-        def fn(q, k, v, bq=bq, bk=bk):
-            out, _ = pa.pallas_flash_attention(
-                q, k, v, causal=args.causal, return_lse=True, block_q=bq,
-                block_k=bk)
-            return out
-
-        def full(q, k, v, bq=bq, bk=bk):
-            # custom fwd+bwd with explicit blocks (bypasses the default-
-            # block custom_vjp wrapper)
-            out, lse = pa.pallas_flash_attention(
-                q, k, v, causal=args.causal, return_lse=True,
-                block_q=bq, block_k=bk)
-            return out, lse
-
-        @functools.partial(jax.custom_vjp)
-        def att(q, k, v):
-            return full(q, k, v)[0]
-
-        def att_fwd(q, k, v):
-            out, lse = full(q, k, v)
-            return out, (q, k, v, out, lse)
-
-        def att_bwd(res, g):
-            q, k, v, out, lse = res
-            return pa.pallas_flash_attention_bwd(
-                q, k, v, out, lse, g, causal=args.causal,
-                block_q=bq, block_k=bk)
-
-        att.defvjp(att_fwd, att_bwd)
         try:
-            s = time_loop(mk_loop(att), q, k, v, sync, iters=args.iters)
+            s = search.measure_attention_config(
+                args.batch, args.heads, S, S, D, "bfloat16",
+                {"block_q": bq, "block_k": bk}, causal=args.causal,
+                inner=args.inner, calls=args.iters)
             print(json.dumps({"block_q": bq, "block_k": bk,
-                              "ms": round(s * 1000 / args.inner, 3),
-                              "tflops": round(flops / s / 1e12 / 1, 1)}),
+                              "ms": round(s * 1000, 3),
+                              "tflops": round(flops / s / 1e12, 1)}),
                   flush=True)
         except Exception as e:
             print(json.dumps({"block_q": bq, "block_k": bk,
@@ -128,9 +66,13 @@ def main():
 
             def jfn(q, k, v):
                 return jf(q, k, v, causal=args.causal, sm_scale=D ** -0.5)
-            s = time_loop(mk_loop(jfn), q, k, v, sync, iters=args.iters)
+            loop = search.fwd_bwd_loop(jfn, args.inner)
+            q, k, v = search._rand_operands(
+                ((args.batch, args.heads, S, D),) * 3, "bfloat16")
+            s = search.min_time(lambda: loop(q, k, v),
+                                calls=args.iters) / args.inner
             print(json.dumps({"impl": "jax_reference",
-                              "ms": round(s * 1000 / args.inner, 3),
+                              "ms": round(s * 1000, 3),
                               "tflops": round(flops / s / 1e12, 1)}),
                   flush=True)
         except Exception as e:

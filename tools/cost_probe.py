@@ -1,6 +1,10 @@
 #!/usr/bin/env python
 """Cost-analysis probe: XLA bytes/flops of the ResNet-50 train step with
-and without backward-mirror remat (scratch tool for the roofline note)."""
+and without backward-mirror remat (scratch tool for the roofline note).
+Thin wrapper: the lower->compile->cost_analysis plumbing lives in
+``mxnet_tpu.tune.search.compiled_cost`` (via ``bench._step_cost_analysis``)
+— the same driver the autotuner uses, so there is ONE measurement/cost
+harness."""
 import json
 import sys
 

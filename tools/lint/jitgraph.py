@@ -631,8 +631,13 @@ class PackageIndex:
         """Second config fixpoint using caller taint: a parameter whose
         every observed argument is UNTAINTED in its caller (a loop index,
         a shape read, a folded constant) is trace-time config, not a
-        tracer.  Monotone — config only grows, taint only shrinks."""
-        for _ in range(2):
+        tracer.  Monotone — config only grows, taint only shrinks.
+        Runs to convergence (bound = #functions, the longest possible
+        caller->helper chain): two sweeps covered the pre-autotune
+        package, but config-hood must reach the bottom of deep
+        trace-time helper chains like dispatch -> cost-table lookup ->
+        search -> candidate enumeration."""
+        for _ in range(max(2, len(self.functions))):
             self._taint_cache = {}
             changed = False
             for fi in self.functions:

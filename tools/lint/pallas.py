@@ -84,6 +84,22 @@ def _global_clamp(index: PackageIndex) -> int:
     return _DEFAULT_CLAMP
 
 
+def _fold_tune_lookup(expr: ast.expr, env) -> Optional[object]:
+    """Blocks that arrive via an autotune cost-table lookup instead of a
+    literal clamp chain: ``table_blocks(family, shape, dtype,
+    default=(bq, bk))`` (mxnet_tpu.tune) folds to its ``default=``
+    fallback config — the config the caller is sized at on a table
+    miss, and the declared anchor the measured search prunes around
+    with the same VMEM predicate this rule checks statically."""
+    if not isinstance(expr, ast.Call) or \
+            call_target_name(expr) != "table_blocks":
+        return None
+    for kw in expr.keywords:
+        if kw.arg == "default":
+            return fold_or_none(kw.value, env)
+    return None
+
+
 def _local_env(module, fi, call_line, base: Dict[str, object]
                ) -> Dict[str, object]:
     """Fold the enclosing function's assignments (source order, up to the
@@ -101,11 +117,15 @@ def _local_env(module, fi, call_line, base: Dict[str, object]
         t = stmt.targets[0]
         if isinstance(t, ast.Name):
             v = fold_or_none(stmt.value, env)
+            if v is None:
+                v = _fold_tune_lookup(stmt.value, env)
             if v is not None:
                 env[t.id] = v
         elif isinstance(t, ast.Tuple) and \
                 all(isinstance(e, ast.Name) for e in t.elts):
             v = fold_or_none(stmt.value, env)
+            if v is None:
+                v = _fold_tune_lookup(stmt.value, env)
             if isinstance(v, tuple) and len(v) == len(t.elts):
                 for e, x in zip(t.elts, v):
                     env[e.id] = x

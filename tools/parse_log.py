@@ -84,6 +84,7 @@ def parse_jsonl(lines):
     hbm = {}
     lockorder = []
     numerics = {}
+    autotune = []
     lint_gate = None
     steps = 0
     for line in lines:
@@ -133,6 +134,16 @@ def parse_jsonl(lines):
             n["nonfinite"] += bad
             if bad and n["first_bad_step"] is None:
                 n["first_bad_step"] = rec.get("step")
+        elif kind == "autotune":
+            # one event per dispatch decision (mxnet_tpu.tune): name is
+            # the source (hit|miss|search|fallback), payload the
+            # instance key + chosen config — the per-shape census
+            autotune.append({"source": rec.get("name"),
+                             "family": rec.get("family"),
+                             "shape": rec.get("shape"),
+                             "dtype": rec.get("dtype"),
+                             "config": rec.get("config"),
+                             "reason": rec.get("reason")})
         elif kind == "lint" and rec.get("name") == "gate":
             lint_gate = rec
         elif kind == "snapshot":
@@ -148,7 +159,7 @@ def parse_jsonl(lines):
     return {"spans": spans, "counters": counters, "gauges": gauges,
             "recompiles": recompiles, "steps": steps, "hbm": hbm,
             "lockorder": lockorder, "numerics": numerics,
-            "lint_gate": lint_gate}
+            "autotune": autotune, "lint_gate": lint_gate}
 
 
 def _render_hbm(hbm, fmt="markdown"):
@@ -211,8 +222,41 @@ def render_jsonl(agg, fmt="markdown"):
         for e in agg["lockorder"]:
             out.append("  %s -> %s" % (e["src"], e["dst"]))
     out.extend(_render_numerics(agg.get("numerics") or {}, fmt))
+    out.extend(_render_autotune(agg.get("autotune") or [],
+                                agg.get("counters") or {}, fmt))
     out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
+
+
+def _render_autotune(autotune, counters, fmt="markdown"):
+    """Per-shape chosen-config table from the autotune journal events
+    (one per dispatch decision) headed by the hit/miss/search/fallback
+    counter line — where every shape's kernel config came from."""
+    if not autotune and not any(k.startswith("autotune.")
+                                for k in counters):
+        return []
+    counts = " ".join("%s=%d" % (k.split(".", 1)[1], counters[k])
+                      for k in sorted(counters)
+                      if k.startswith("autotune."))
+    out = ["", "autotune decisions (cost-table census%s):"
+           % (": " + counts if counts else "")]
+    if not autotune:
+        return out
+    header = ["family", "shape", "dtype", "source", "config"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    for e in autotune:
+        cfg = e.get("config")
+        cfg_s = " ".join("%s=%s" % (k, cfg[k]) for k in sorted(cfg)) \
+            if isinstance(cfg, dict) else (e.get("reason") or "-")
+        vals = [str(e.get("family", "?")),
+                "x".join(str(d) for d in (e.get("shape") or [])) or "-",
+                str(e.get("dtype", "?")), str(e.get("source", "?")),
+                cfg_s]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
 
 
 def _render_numerics(numerics, fmt="markdown"):
