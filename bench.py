@@ -748,6 +748,97 @@ def bench_zero_sharded_update(batch_size=256, hidden=2048, iters=8):
             "state_bytes_ok": n <= 1 or bytes_sh * (n - 1) < bytes_rep * n}
 
 
+def bench_checkpoint_overhead(batch_size=256, hidden=512, iters=8,
+                              every=32):
+    """A/B of the SAME compiled MLP train step with async checkpointing
+    OFF vs ON every ``every`` steps (``mxnet_tpu.checkpoint``): the
+    step-side cost is ONE jitted device-copy dispatch + a queue put,
+    the host transfer and file IO ride the background writer thread.
+    Timed as interleaved min-of-``every``-step windows so both legs see
+    the same host contention and every ON window contains exactly one
+    snapshot.  ``overhead_pct`` > 2 is a HARD bench failure
+    (_hard_failures), mirroring the telemetry-overhead gate: periodic
+    durability must stay effectively free on the hot path.  Negative
+    deltas are timing noise and clamp to 0.
+
+    The default cadence (every 32 steps) is the floor of "periodic":
+    the snapshot dispatch costs roughly one extra step dispatch on the
+    virtual-device CPU backend (on a real chip the copy is HBM
+    traffic, ~free), so sparser production cadences only lower the
+    overhead."""
+    import shutil
+    import tempfile
+    import time
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint, gluon, parallel, telemetry
+    from mxnet_tpu.gluon import nn
+
+    n = len(jax.local_devices())
+    mesh = parallel.device_mesh((n,), ("dp",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def leg():
+        onp.random.seed(7)
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden, activation="relu"),
+                nn.Dense(hidden // 2, activation="relu"), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(batch_size, 123).astype("float32"))
+        y = mx.nd.array(
+            onp.random.randint(0, 10, (batch_size,)).astype("float32"))
+        net(x)
+        step = parallel.DataParallelStep(
+            net, lambda o, l: loss_fn(o, l),
+            mx.optimizer.Adam(learning_rate=1e-3), mesh=mesh,
+            shard_optimizer=True)
+        step(x, y)   # compile + first update
+        return step, (x, y)
+
+    step_off, b_off = leg()
+    step_on, b_on = leg()
+    ckpt_dir = tempfile.mkdtemp(prefix="mxtpu_bench_ckpt_")
+    writes0 = telemetry.counter("ckpt.writes")
+    mgr = checkpoint.CheckpointManager(ckpt_dir, step_on,
+                                       every_n_steps=every)
+    mgr.attach()
+    ms_off = ms_on = None
+    try:
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            for _ in range(every):
+                step_off(*b_off)
+            step_off(*b_off).asnumpy()
+            d = (time.perf_counter() - t0) * 1e3
+            ms_off = d if ms_off is None else min(ms_off, d)
+            t0 = time.perf_counter()
+            for _ in range(every):
+                step_on(*b_on)
+            step_on(*b_on).asnumpy()
+            d = (time.perf_counter() - t0) * 1e3
+            ms_on = d if ms_on is None else min(ms_on, d)
+        flushed = mgr.flush(60.0)
+    finally:
+        mgr.close()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    writes = telemetry.counter("ckpt.writes") - writes0
+    stats = mgr.stats()
+    overhead = max(0.0, (ms_on - ms_off) / ms_off * 100.0)
+    return {"bench": "checkpoint_overhead", "batch_size": batch_size,
+            "hidden": hidden, "every_n_steps": every, "n_shards": n,
+            "window_ms_ckpt_off": round(ms_off, 3),
+            "window_ms_ckpt_on": round(ms_on, 3),
+            "overhead_pct": round(overhead, 3),
+            "overhead_ok": overhead <= 2.0,
+            "ckpt_writes": writes, "ckpt_flushed": bool(flushed),
+            "ckpt_bytes": (stats["last_written"] or {}).get("bytes"),
+            "ckpt_write_ms": round(
+                (stats["last_written"] or {}).get("dur_ms") or 0.0, 3),
+            "ckpt_errors": stats["last_error"]}
+
+
 def bench_ssd(batch_size=32, image_size=128, iters=8):
     """SSD detection train step ON-DEVICE (reference example/ssd +
     multibox_target.cu): forward + MultiBoxTarget assignment (pure
@@ -1019,6 +1110,8 @@ def main():
             iters=max(6, args.iters // 2)))
         jobs.append(lambda: bench_zero_sharded_update(
             iters=max(4, args.iters // 3)))
+        jobs.append(lambda: bench_checkpoint_overhead(
+            iters=max(4, args.iters // 3)))
         jobs.append(bench_input_pipeline_isolated)
     else:
         # the default run covers every BASELINE.json config (the driver
@@ -1084,6 +1177,10 @@ def main():
         # step time, replicated vs shard_optimizer=True (dp mesh over
         # all local devices; n_shards=1 degenerates gracefully)
         jobs.append(lambda: bench_zero_sharded_update(
+            iters=max(4, it // 3)))
+        # async checkpointing must stay <= 2% on the hot step at the
+        # default cadence (hard gate, mirroring the telemetry gate)
+        jobs.append(lambda: bench_checkpoint_overhead(
             iters=max(4, it // 3)))
         # input pipeline (rec -> host -> device -> step legs) — in a FRESH
         # subprocess: after ~14 jobs this process's accumulated jax
@@ -1189,7 +1286,10 @@ def _hard_failures(details):
         so a regressing table entry fails the run (re-tune or delete
         the entry);
       * ``telemetry_overhead`` > 2% — the always-on telemetry layer's
-        whole contract is that it is too cheap to ever turn off.
+        whole contract is that it is too cheap to ever turn off;
+      * ``checkpoint_overhead`` > 2% — async checkpointing at the
+        default cadence must be effectively free on the hot step, or
+        nobody leaves durability on in production.
     """
     hard = []
     for d in details:
@@ -1199,6 +1299,12 @@ def _hard_failures(details):
                 and d.get("overhead_ok") is False:
             hard.append("telemetry overhead %.2f%% > 2%% on the "
                         "bert_mlm_train step" % d.get("overhead_pct", 0))
+        if d.get("bench") == "checkpoint_overhead" \
+                and d.get("overhead_ok") is False:
+            hard.append("async checkpoint overhead %.2f%% > 2%% at "
+                        "cadence every=%s on the MLP train step"
+                        % (d.get("overhead_pct", 0),
+                           d.get("every_n_steps")))
         if d.get("max_err_ok") is False:
             hard.append("max_err_ok false: %s %s max_err=%s"
                         % (d.get("bench"), d.get("shape"),

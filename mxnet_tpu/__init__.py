@@ -62,6 +62,7 @@ from . import symbol
 from . import symbol as sym
 from . import executor
 from . import model
+from . import checkpoint
 from . import module
 from . import module as mod
 from . import callback

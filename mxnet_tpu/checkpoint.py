@@ -1,0 +1,517 @@
+"""Async atomic sharded checkpoints for elastic, preemption-tolerant runs.
+
+The recovery tier below the live mesh re-formation in
+``parallel/elastic.py``: when a failure loses state that cannot be
+re-sharded from survivors (a dead worker's ZeRO shard, a coordinator
+restart), the job restarts from the last *committed* checkpoint — so
+checkpoints must (a) cost ~nothing on the training step, (b) never be
+observable half-written, and (c) restore into a DIFFERENT world size
+than they were saved from.
+
+* **Async**: the step-side cost is capturing *references* to the (jax,
+  immutable) param/state arrays plus layout metadata — no device sync,
+  no copy.  A background writer thread does the host transfer and file
+  IO; if a write is still in flight when the next cadence point
+  arrives, the new snapshot is SKIPPED (``ckpt.skipped``), never queued
+  behind — training never stalls on the disk.
+* **Atomic**: every file goes through tmp + ``os.replace``
+  (:func:`atomic_path`), and a checkpoint only becomes *the* checkpoint
+  when ``manifest.json`` — itself replaced atomically, after every
+  shard file of that step exists — points at it.  A crash at any
+  byte of the write sequence leaves the previous manifest (and the
+  previous complete checkpoint) in force.
+* **World-size independent**: optimizer state is written as per-dp-rank
+  shards of the flat zero-padded ZeRO layout
+  (``parallel/collectives.py``), but the manifest records the natural
+  shapes — restore concatenates the shards, drops the padding, and
+  re-shards onto whatever dp extent the restoring job runs
+  (``DataParallelStep.load_checkpoint_state``).  All of it is byte
+  movement, never arithmetic, so the materialized state round-trips
+  bitwise across world sizes.
+
+Layout on disk::
+
+    <dir>/manifest.json                    # atomic commit point
+    <dir>/step-00000040/meta.json          # layout: shapes/dtypes/dp
+    <dir>/step-00000040/params.npz         # replicated params (rank 0)
+    <dir>/step-00000040/state-00000-of-00004.npz   # dp-shard 0 chunks
+    ...
+
+Journal events: ``ckpt/write`` (step, world, bytes, dur_ms),
+``ckpt/restore`` (step, world_from, world_to, bytes, dur_ms),
+``ckpt/skipped``, ``ckpt/write_failed`` — rendered by
+``tools/parse_log.py --jsonl``.  See docs/ROBUSTNESS.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import shutil
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as onp
+
+from . import telemetry
+from .base import MXNetError
+
+__all__ = ["CheckpointManager", "atomic_path", "read_manifest",
+           "restore_latest", "MANIFEST"]
+
+MANIFEST = "manifest.json"
+_FORMAT = 1
+
+
+@contextmanager
+def atomic_path(path):
+    """Atomic file write: yields a tmp path next to ``path``; on clean
+    exit the tmp is ``os.replace``d over ``path`` (atomic on POSIX), so
+    a crash mid-write can never leave a torn file at ``path`` — readers
+    see the old complete file or the new complete file, nothing in
+    between.  The ``checkpoint_write_crash`` chaos fault fires in the
+    window between write and commit, simulating exactly that crash."""
+    from .parallel import chaos
+    # pid AND thread id: the async writer thread and a main-thread
+    # save(block=True) may write the same target concurrently — two
+    # threads sharing one tmp name would interleave into a torn commit
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), threading.get_ident())
+    try:
+        yield tmp
+        if chaos.should_fire("checkpoint_write_crash", path=path):
+            raise chaos.ChaosError(
+                "checkpoint_write_crash injected before commit of %s"
+                % path)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+_DTYPES_KEY = "__mxtpu_dtypes__"
+
+
+def _np_dtype(name):
+    """numpy dtype from its recorded name, including the ml_dtypes
+    family (bfloat16 etc.) that plain ``onp.dtype`` may not resolve."""
+    try:
+        return onp.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return onp.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_payload(payload):
+    """npz-safe encoding: custom dtypes (ml_dtypes bfloat16 registers
+    kind 'V', which npz round-trips as raw void) travel as uint8 bytes
+    with a JSON sidecar key recording dtype + shape."""
+    out, sidecar = {}, {}
+    for k, v in payload.items():
+        if v.dtype.kind in "biufc":
+            out[k] = v
+        else:
+            out[k] = onp.ascontiguousarray(v).reshape(-1).view(onp.uint8)
+            sidecar[k] = [str(v.dtype), list(v.shape)]
+    if sidecar:
+        out[_DTYPES_KEY] = onp.frombuffer(
+            json.dumps(sidecar).encode(), dtype=onp.uint8)
+    return out
+
+
+def _decode_npz(z):
+    """Dict of decoded arrays from an open npz (inverse of
+    ``_encode_payload``)."""
+    sidecar = {}
+    if _DTYPES_KEY in z.files:
+        sidecar = json.loads(bytes(z[_DTYPES_KEY]).decode())
+    out = {}
+    for k in z.files:
+        if k == _DTYPES_KEY:
+            continue
+        v = z[k]
+        if k in sidecar:
+            dtype, shape = sidecar[k]
+            v = v.view(_np_dtype(dtype)).reshape(shape)
+        out[k] = v
+    return out
+
+
+def _write_npz(path, payload):
+    """Atomically write a dict of numpy arrays as ``path`` (.npz)."""
+    with atomic_path(path) as tmp:
+        with open(tmp, "wb") as fh:
+            onp.savez(fh, **_encode_payload(payload))
+    return sum(int(a.nbytes) for a in payload.values())
+
+
+def _prod(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _flatten_pad_np(arr, axis_size):
+    """Numpy twin of ``collectives.flatten_pad`` (byte movement only,
+    no device compute): flatten, zero-pad to a multiple of
+    ``axis_size``."""
+    from .parallel.collectives import padded_size
+    flat = onp.asarray(arr).ravel()
+    out = onp.zeros((padded_size(flat.shape[0], axis_size),), flat.dtype)
+    out[:flat.shape[0]] = flat
+    return out
+
+
+def read_manifest(directory):
+    """The committed manifest dict, or None (no/corrupt manifest — a
+    torn manifest is impossible by construction, but a foreign file is
+    not a crash)."""
+    path = os.path.join(directory, MANIFEST)
+    try:
+        with open(path) as fh:
+            man = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return man if isinstance(man, dict) and "dir" in man else None
+
+
+class CheckpointManager:
+    """Periodic async atomic checkpoints of a ``DataParallelStep``.
+
+    ::
+
+        mgr = checkpoint.CheckpointManager(dir, step, every_n_steps=50)
+        mgr.attach()            # saves ride the telemetry step hook
+        ... training ...
+        mgr.close()             # drain + stop the writer thread
+
+    ``async_write=False`` writes inline on ``save()`` (tests, final
+    checkpoints).  Multi-process runs give each worker its ``rank`` /
+    ``world_size`` and the dp-shard indices it ``owns``; rank 0
+    additionally writes the replicated params + meta and commits the
+    manifest once every shard file of the step exists.
+    """
+
+    def __init__(self, directory, target=None, every_n_steps=0,
+                 async_write=True, keep=2, rank=0, world_size=1,
+                 owned_shards=None, commit_timeout=10.0):
+        self._dir = directory
+        self._target = target
+        self._every = int(every_n_steps)
+        self._keep = max(1, int(keep))
+        self._rank = int(rank)
+        self._world = int(world_size)
+        self._owned = owned_shards
+        self._commit_timeout = float(commit_timeout)
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._last_written = None      # {"step", "bytes", "dur_ms"}
+        self._last_error = None
+        self._hook = None
+        self._q = None
+        self._stop = threading.Event()
+        self._thread = None
+        if async_write:
+            self._q = queue.Queue(maxsize=2)
+            self._thread = threading.Thread(
+                target=self._writer, name="mxtpu-ckpt-writer", daemon=True)
+            self._thread.start()
+
+    # -- training-loop integration -------------------------------------
+    def attach(self, target=None):
+        """Install the cadence hook: every ``every_n_steps``-th step of
+        the target journals a snapshot onto the writer queue (the same
+        step-hook channel Monitor/Speedometer ride — no loop
+        plumbing)."""
+        if target is not None:
+            self._target = target
+        if self._hook is not None or not self._every:
+            return self
+
+        def _hook(rec):
+            if rec.get("owner") is not self._target:
+                return
+            idx = rec.get("index")
+            if idx is None or (int(idx) + 1) % self._every:
+                return
+            self.save()
+
+        self._hook = telemetry.add_step_hook(_hook)
+        return self
+
+    def detach(self):
+        if self._hook is not None:
+            telemetry.remove_step_hook(self._hook)
+            self._hook = None
+
+    def save(self, block=False):
+        """Snapshot the target now.  Async mode enqueues array
+        *references* (cheap; jax arrays are immutable) and returns
+        immediately — unless the previous write is still in flight, in
+        which case this snapshot is dropped (``ckpt.skipped``) so the
+        step never waits on the disk.  ``block=True`` (or sync mode)
+        writes before returning."""
+        if self._target is None:
+            raise MXNetError("CheckpointManager has no target; pass one "
+                             "to attach()/save() or the constructor")
+        snap = self._target.checkpoint_state()
+        if self._q is None or block:
+            self._write(snap, time.perf_counter())
+            return True
+        try:
+            with self._lock:
+                self._pending += 1
+            self._q.put_nowait((snap, time.perf_counter()))
+        except queue.Full:
+            with self._lock:
+                self._pending -= 1
+            telemetry.inc("ckpt.skipped")
+            telemetry.event("ckpt", "skipped", step=int(snap["step"]),
+                            reason="previous write still in flight")
+            return False
+        return True
+
+    def flush(self, timeout=30.0):
+        """Wait until every queued snapshot is on disk (bounded)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                pending = self._pending
+            if not pending:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def stats(self):
+        with self._lock:
+            return {"pending": self._pending,
+                    "last_written": dict(self._last_written)
+                    if self._last_written else None,
+                    "last_error": self._last_error}
+
+    def close(self, timeout=30.0):
+        """Drain, stop and join the writer thread; detach the hook.
+        Idempotent."""
+        self.detach()
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            self.flush(timeout)
+            t.join(timeout)
+        self._thread = None
+
+    # -- writer thread --------------------------------------------------
+    def _writer(self):
+        while True:
+            try:
+                job = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                self._write(*job)
+            except Exception as e:
+                # a failed write (disk full, injected crash) must never
+                # kill training: journal it and keep the previous
+                # committed checkpoint in force
+                telemetry.inc("ckpt.write_failures")
+                telemetry.event("ckpt", "write_failed", error=repr(e),
+                                step=int(job[0].get("step", -1)))
+                with self._lock:
+                    self._last_error = repr(e)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+
+    # -- write path ------------------------------------------------------
+    @staticmethod
+    def _shard_chunks(leaf, dp):
+        """``{dp-index: host chunk}`` of a flat padded leaf.  A
+        fully-addressable array (single-controller) takes one host
+        copy and slices; on a multi-host mesh only the ADDRESSABLE
+        shards materialize — a worker can (and should) write exactly
+        the chunks it owns, never the global value."""
+        total = int(leaf.shape[0])
+        chunk = total // dp
+        if bool(getattr(leaf, "is_fully_addressable", True)):
+            flat = onp.asarray(leaf).ravel()
+            return {k: flat[k * chunk:(k + 1) * chunk]
+                    for k in range(dp)}
+        out = {}
+        for sh in leaf.addressable_shards:
+            start = sh.index[0].start or 0
+            data = onp.asarray(sh.data).ravel()
+            # one device shard may span several file chunks when the
+            # mesh has fewer devices than dp; emit chunk-aligned slices
+            for off in range(0, data.shape[0], chunk):
+                out[(start + off) // chunk] = data[off:off + chunk]
+        return out
+
+    def _owned_indices(self, dp):
+        if self._owned is not None:
+            return [k for k in self._owned if 0 <= k < dp]
+        if self._world > 1 and dp == self._world:
+            return [self._rank]     # real pod: each worker owns its shard
+        return list(range(dp)) if self._rank == 0 else []
+
+    def _write(self, snap, t_enq):
+        t0 = time.perf_counter()
+        step = int(snap["step"])
+        dp = int(snap["dp"])
+        sdir = os.path.join(self._dir, "step-%08d" % step)
+        os.makedirs(sdir, exist_ok=True)
+        nbytes = 0
+        # materialize ONCE per leaf: host copy + natural shape (and,
+        # for sharded slots, the per-dp-index chunks the shard files
+        # hold) — pure byte movement, no arithmetic.  On a multi-host
+        # mesh a worker can only read its ADDRESSABLE shards, which
+        # are exactly the chunks it owns (the in-memory flat padded
+        # layout and the file layout share the same dp extent).
+        slots = []
+        for rec in snap["slots"]:
+            shape = tuple(rec["shape"])
+            nats, chunks = [], []
+            for leaf in rec["leaves"]:
+                if rec["sharded"]:
+                    chunks.append(self._shard_chunks(leaf, dp))
+                    nats.append(None)
+                else:
+                    nats.append(onp.asarray(leaf))
+            slots.append({"nats": nats, "chunks": chunks,
+                          "dtypes": [str(leaf.dtype)
+                                     for leaf in rec["leaves"]],
+                          "sharded": bool(rec["sharded"]),
+                          "shape": shape, "mp": bool(rec.get("mp"))})
+        if self._rank == 0:
+            names = snap.get("param_names") or \
+                ["p%06d" % i for i in range(len(snap["params"]))]
+            params = {"p%06d" % i: onp.asarray(v)
+                      for i, v in enumerate(snap["params"])}
+            nbytes += _write_npz(os.path.join(sdir, "params.npz"), params)
+            meta = {"format": _FORMAT, "step": step, "dp": dp,
+                    "world_size": self._world,
+                    "slots": [{"sharded": s["sharded"],
+                               "shape": list(s["shape"]),
+                               "dtypes": s["dtypes"],
+                               "n_leaves": len(s["dtypes"]),
+                               "mp": s["mp"]} for s in slots],
+                    "params": [{"name": name,
+                                "shape": list(params["p%06d" % i].shape),
+                                "dtype": str(params["p%06d" % i].dtype)}
+                               for i, name in enumerate(names)]}
+            with atomic_path(os.path.join(sdir, "meta.json")) as tmp:
+                with open(tmp, "w") as fh:
+                    json.dump(meta, fh)
+        for k in self._owned_indices(dp):
+            payload = {}
+            for slot, s in enumerate(slots):
+                if s["sharded"]:
+                    for j, ch in enumerate(s["chunks"]):
+                        if k in ch:
+                            payload["s%d.l%d" % (slot, j)] = ch[k]
+                elif k == 0:
+                    for j, nat in enumerate(s["nats"]):
+                        payload["s%d.l%d" % (slot, j)] = nat
+            nbytes += _write_npz(
+                os.path.join(sdir, "state-%05d-of-%05d.npz" % (k, dp)),
+                payload)
+        if self._rank == 0:
+            self._commit(sdir, step, dp, t0, t_enq, nbytes)
+
+    def _commit(self, sdir, step, dp, t0, t_enq, nbytes):
+        """Point the manifest at ``sdir`` once every shard file of the
+        step exists (other ranks write theirs concurrently); then prune
+        superseded step dirs."""
+        expect = [os.path.join(sdir, "params.npz"),
+                  os.path.join(sdir, "meta.json")]
+        expect += [os.path.join(sdir, "state-%05d-of-%05d.npz" % (k, dp))
+                   for k in range(dp)]
+        deadline = time.monotonic() + self._commit_timeout
+        while any(not os.path.exists(p) for p in expect):
+            if time.monotonic() >= deadline:
+                telemetry.inc("ckpt.write_failures")
+                telemetry.event(
+                    "ckpt", "write_failed", step=step,
+                    error="incomplete shard set after %.1fs"
+                          % self._commit_timeout)
+                return
+            time.sleep(0.02)
+        man = {"format": _FORMAT, "step": step, "dp": dp,
+               "world_size": self._world, "dir": os.path.basename(sdir)}
+        with atomic_path(os.path.join(self._dir, MANIFEST)) as tmp:
+            with open(tmp, "w") as fh:
+                json.dump(man, fh)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        telemetry.inc("ckpt.writes")
+        telemetry.event("ckpt", "write", step=step, world=dp,
+                        bytes=int(nbytes), dur_ms=round(dur_ms, 3),
+                        queued_ms=round((t0 - t_enq) * 1e3, 3))
+        with self._lock:
+            self._last_written = {"step": step, "bytes": int(nbytes),
+                                  "dur_ms": dur_ms}
+        self._prune(keep_dir=os.path.basename(sdir))
+
+    def _prune(self, keep_dir):
+        dirs = sorted(d for d in os.listdir(self._dir)
+                      if d.startswith("step-"))
+        for d in dirs[:-self._keep]:
+            if d != keep_dir:
+                shutil.rmtree(os.path.join(self._dir, d),
+                              ignore_errors=True)
+
+
+def restore_latest(directory, target):
+    """Restore ``target`` (a ``DataParallelStep``) from the manifest's
+    checkpoint — saved at ANY world size: shards are concatenated,
+    padding dropped, and the state re-shards onto the target's current
+    dp extent on load.  Returns the restored step index."""
+    man = read_manifest(directory)
+    if man is None:
+        raise MXNetError("no committed checkpoint manifest in %r"
+                         % (directory,))
+    t0 = time.perf_counter()
+    sdir = os.path.join(directory, man["dir"])
+    with open(os.path.join(sdir, "meta.json")) as fh:
+        meta = json.load(fh)
+    dp = int(meta["dp"])
+    nbytes = 0
+    with onp.load(os.path.join(sdir, "params.npz")) as z:
+        decoded = _decode_npz(z)
+    params = [decoded[k] for k in sorted(decoded)]
+    nbytes += sum(int(v.nbytes) for v in params)
+    shards = []
+    for k in range(dp):
+        with onp.load(os.path.join(
+                sdir, "state-%05d-of-%05d.npz" % (k, dp))) as z:
+            shards.append(_decode_npz(z))
+    slots = []
+    for slot, srec in enumerate(meta["slots"]):
+        shape = tuple(srec["shape"])
+        leaves = []
+        for j in range(int(srec["n_leaves"])):
+            key = "s%d.l%d" % (slot, j)
+            if srec["sharded"]:
+                flat = onp.concatenate([shards[k][key]
+                                        for k in range(dp)])
+                nat = flat[:_prod(shape)].reshape(shape)
+            else:
+                nat = shards[0][key]
+            leaves.append(nat)
+            nbytes += int(nat.nbytes)
+        slots.append({"leaves": leaves, "shape": shape,
+                      "mp": bool(srec.get("mp"))})
+    target.load_checkpoint_state(
+        {"step": int(meta["step"]), "params": params, "slots": slots})
+    telemetry.inc("ckpt.restores")
+    telemetry.event(
+        "ckpt", "restore", step=int(meta["step"]), world_from=dp,
+        world_to=int(getattr(target, "_shard_n", 0) or 1),
+        bytes=int(nbytes),
+        dur_ms=round((time.perf_counter() - t0) * 1e3, 3))
+    return int(meta["step"])

@@ -164,6 +164,20 @@ class _FusedUpdate:
         """Drop the mirror (externally loaded states take over)."""
         self._sharded.clear()
 
+    def reset_mesh(self):
+        """Elastic re-formation: gather the mirror back (its shards are
+        about to be re-laid-out), drop it, and forget the mesh — the
+        next step re-probes ``_shard_ready`` against the NEW process
+        mesh and rebuilds the mirror at the new dp extent.  The jitted
+        executables are compiled against the old mesh's shardings, so
+        the cache goes too."""
+        self.materialize_states()
+        self.invalidate_sharded()
+        self._shard_mesh = None
+        self._shard_n = 0
+        self._shard_skip_reported = False
+        self._cache.clear()
+
     def __call__(self, indices, grads, weights):
         if self._unavailable:
             return False
@@ -598,6 +612,55 @@ class Trainer:
         for i, g, w in zip(indices, grads, weights):
             self._updaters(i, g, w)
 
+    def reshard(self, mesh):
+        """Re-form this trainer onto a new mesh after an elastic
+        transition (``parallel/elastic.py``): the ZeRO mirrors gather
+        back into the updater's natural-shape states (bitwise) and are
+        dropped, every weight/gradient/state leaf re-places onto the
+        survivors' mesh (replicated — the eager training layout), and
+        the fused update re-engages its dp-sharded mirror at the NEW
+        extent on the next step.  Returns the bytes moved."""
+        import jax
+        import jax.numpy as jnp
+        from .. import parallel
+        from ..parallel import NamedSharding, P
+        for fused in (self._kv_fused, self._local_fused):
+            if fused is not None:
+                fused.reset_mesh()
+        parallel.set_mesh(mesh)
+        repl = NamedSharding(mesh, P()) if mesh is not None else None
+        moved = 0
+
+        def _replace(shell):
+            nonlocal moved
+            host = onp.asarray(shell._data)
+            moved += host.nbytes
+            shell._data = jax.device_put(host, repl) \
+                if repl is not None else jnp.asarray(host)
+
+        is_nd = lambda x: isinstance(x, NDArray)  # noqa: E731
+        with autograd.pause():
+            for param in self._params:
+                if param._data is not None:
+                    _replace(param._data)
+                if getattr(param, "_grad", None) is not None:
+                    _replace(param._grad)
+            # natural-shape updater states follow (they feed the next
+            # fused program; stale old-mesh placements would force a
+            # second migration inside jit)
+            seen = set()
+            for fused in (self._kv_fused, self._local_fused):
+                if fused is None or id(fused._updater) in seen:
+                    continue
+                seen.add(id(fused._updater))
+                for st in fused._updater.states.values():
+                    leaves, _ = jax.tree_util.tree_flatten(
+                        st, is_leaf=is_nd)
+                    for l in leaves:
+                        if isinstance(l, NDArray):
+                            _replace(l)
+        return moved
+
     def _sync_sharded_states(self, invalidate=False):
         """ZeRO mirror maintenance around state (de)serialization: the
         fused updates keep dp-sharded flat state mirrors that make the
@@ -613,18 +676,25 @@ class Trainer:
                 fused.materialize_states()
 
     def save_states(self, fname):
-        """(reference trainer.py:440)"""
+        """(reference trainer.py:440).  The write is atomic (tmp +
+        ``os.replace`` via ``checkpoint.atomic_path``): a crash
+        mid-write leaves the previous states file intact instead of a
+        torn pickle — regression-tested with the chaos
+        ``checkpoint_write_crash`` fault."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
         self._sync_sharded_states()
+        from ..checkpoint import atomic_path
         if self._update_on_kvstore and self._kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters.get_states(dump_optimizer=True))
+            with atomic_path(fname) as tmp:
+                with open(tmp, "wb") as fout:
+                    fout.write(self._updaters.get_states(
+                        dump_optimizer=True))
 
     def load_states(self, fname):
         """(reference trainer.py:463)"""

@@ -175,8 +175,12 @@ class KVStore:
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
-        with open(fname, "wb") as fout:
-            fout.write(self._updater.get_states(dump_optimizer))
+        # atomic (tmp + os.replace): a crash mid-write must leave the
+        # previous states file intact, never a torn pickle
+        from .checkpoint import atomic_path
+        with atomic_path(fname) as tmp:
+            with open(tmp, "wb") as fout:
+                fout.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot load states for distributed training"
@@ -437,6 +441,14 @@ def _hb_window() -> float:
     return float(os.environ.get("MXNET_TPU_HEARTBEAT_TIMEOUT", "10"))
 
 
+def _hb_retries() -> int:
+    """Consecutive publish failures the heartbeat publisher rides out
+    (with exponential backoff + jitter between attempts) before it
+    concludes the coordinator is really gone and gives up."""
+    import os
+    return int(os.environ.get("MXNET_TPU_HEARTBEAT_RETRIES", "8"))
+
+
 def _start_liveness_heartbeat():
     """Start this process's heartbeat publisher (idempotent; only on
     multi-process runs whose coordination client lacks a native liveness
@@ -454,8 +466,11 @@ def _start_liveness_heartbeat():
     if client is None or hasattr(client, "get_live_nodes"):
         return
     import atexit
+    import random as _random
     import threading
     import time as _time
+    from . import telemetry
+    from .parallel import chaos as _chaos
     rank = jax.process_index()
     interval = max(0.5, _hb_window() / 4.0)
     stop = threading.Event()
@@ -463,11 +478,20 @@ def _start_liveness_heartbeat():
     def beat():
         # a transient coordinator error (RPC deadline while it serves a
         # barrier) must NOT kill the publisher — a dead publisher makes
-        # every peer count this LIVE worker as dead.  Only give up
-        # after several consecutive failures (coordinator really gone,
-        # e.g. shutdown), or when the owner signals shutdown.
+        # every peer count this LIVE worker as dead.  Failed attempts
+        # retry under bounded exponential backoff + deterministic
+        # per-rank jitter (N workers must not stampede a recovering
+        # coordinator in lockstep), give up only after
+        # MXNET_TPU_HEARTBEAT_RETRIES consecutive misses (coordinator
+        # really gone, e.g. shutdown) — journaled ONCE as
+        # elastic/publisher_giveup — or when the owner signals shutdown.
         misses = 0
-        while misses < 5 and not stop.is_set():
+        rng = _random.Random(0xBEA7 + rank)
+        while not stop.is_set():
+            if _chaos.should_fire("drop_heartbeat", rank=rank):
+                # injected partition: alive, but silent to every peer
+                stop.wait(interval)
+                continue
             try:
                 try:
                     client.key_value_set(_HB_KEY % rank,
@@ -486,9 +510,23 @@ def _start_liveness_heartbeat():
                 misses = 0
             except Exception:
                 misses += 1
+                telemetry.inc("elastic.heartbeat_misses")
+                if misses >= _hb_retries():
+                    telemetry.event("elastic", "publisher_giveup",
+                                    rank=rank, misses=misses)
+                    return
             # Event.wait, not time.sleep: shutdown interrupts the
-            # inter-beat pause instead of waiting out the interval
-            stop.wait(interval)
+            # inter-beat pause instead of waiting out the interval.
+            # The half-window cap applies AFTER the jitter multiply —
+            # the cap exists so a recovering publisher re-announces
+            # itself before peers call it dead, and a jittered wait
+            # must not stretch past it.
+            if misses:
+                delay = interval * (2.0 ** (misses - 1)) \
+                    * (1.0 + 0.5 * rng.random())
+                stop.wait(min(_hb_window() / 2.0, delay))
+            else:
+                stop.wait(interval)
 
     t = threading.Thread(target=beat, name="mxtpu-heartbeat", daemon=True)
     _hb_state["stop"] = stop

@@ -90,7 +90,12 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """Save ``prefix-symbol.json`` + ``prefix-%04d.params`` (reference
-    model.py save_checkpoint; same two-file layout so tooling matches)."""
+    model.py save_checkpoint; same two-file layout so tooling matches).
+
+    Both writes are atomic (tmp + ``os.replace`` inside ``nd.save`` /
+    ``Symbol.save``): a crash mid-write — the chaos
+    ``checkpoint_write_crash`` fault — leaves any previous checkpoint
+    at the same path intact instead of a torn file."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}

@@ -40,10 +40,14 @@ def save(fname: str, data) -> None:
             payload[_LIST_PREFIX + str(i)] = v.asnumpy()
     else:
         raise TypeError("data needs to either be a NDArray, dict of str to NDArray")
-    onp.savez(fname if fname.endswith(".npz") else fname, **payload)
-    # numpy appends .npz; rename to the exact requested path
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    # atomic write (tmp + os.replace): checkpoints are the recovery
+    # tier of elastic training — a crash mid-save must leave the
+    # previous file intact, never a torn container (the chaos
+    # ``checkpoint_write_crash`` fault regression-tests exactly this)
+    from ..checkpoint import atomic_path
+    with atomic_path(fname) as tmp:
+        with open(tmp, "wb") as fh:
+            onp.savez(fh, **payload)
 
 
 def load(fname: str, ctx=None) -> Union[List[NDArray], Dict[str, NDArray]]:

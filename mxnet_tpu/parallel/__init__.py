@@ -37,6 +37,8 @@ from .collectives import (  # noqa: F401
     unflatten,
 )
 from .data_parallel import DataParallelStep  # noqa: F401
+from .elastic import ElasticContext, kv_retry  # noqa: F401
+from . import chaos  # noqa: F401
 from .ring_attention import (  # noqa: F401
     blockwise_attention, ring_attention, ring_attention_sharded)
 from .pipeline import (pipeline_apply, pipeline_train_step,  # noqa: F401
@@ -49,7 +51,8 @@ __all__ = [
     "allreduce", "all_gather", "all_gather_unpad", "flatten_pad",
     "padded_size", "pmean", "ppermute", "psum", "reduce_scatter",
     "reduce_scatter_padded", "unflatten",
-    "DataParallelStep", "ring_attention", "ring_attention_sharded",
+    "DataParallelStep", "ElasticContext", "kv_retry", "chaos",
+    "ring_attention", "ring_attention_sharded",
     "blockwise_attention", "shard_batch", "replicate", "initialize",
     "pipeline_apply",
     "pipeline_train_step",

@@ -154,6 +154,9 @@ class DataParallelStep:
         # half weight, the update applies to the master in fp32, and
         # the half weight is re-quantized from it each step — small
         # updates accumulate instead of rounding away.
+        # the raw knob is kept for elastic re-formation: reshard() must
+        # re-resolve "auto" against the NEW mesh's dp extent
+        self._shard_knob = shard_optimizer
         # NOTE: the flattened leaf lists below are NOT covered by the
         # optimizer's own state treedef — multi-precision slots carry the
         # fp32 master as an EXTRA leaf 0 prepended after flattening, and
@@ -208,6 +211,9 @@ class DataParallelStep:
         self._t_dev = None
         self._rng_dev = None
         self._rng_epoch = None
+        # one jitted copy-program for checkpoint snapshots (see
+        # checkpoint_state)
+        self._ckpt_copier = None
 
     # ------------------------------------------------------------------
     # ZeRO-style sharded weight update (arxiv 2004.13336)
@@ -379,6 +385,207 @@ class DataParallelStep:
             telemetry.event("hbm", "estimate",
                             program="DataParallelStep[%x]" % id(self),
                             mode="scan" if scan else "call", **est)
+
+    # ------------------------------------------------------------------
+    # elastic re-formation + checkpoint state (parallel/elastic.py,
+    # mxnet_tpu/checkpoint.py)
+    # ------------------------------------------------------------------
+    def _materialize_slot(self, slot):
+        """Natural-shape HOST copies of one slot's state leaves (the
+        fp32 master first under multi-precision) — the ZeRO checkpoint
+        gather, done in numpy so it is pure byte movement: drop the
+        flat layout's pad lanes, restore the master shape, never touch
+        a value."""
+        shape = self._shard_meta[slot]
+        n = 1
+        for d in shape:
+            n *= int(d)
+        out = []
+        for l in self._opt_states[slot]:
+            host = onp.asarray(l)
+            if self._shard_slots[slot]:
+                host = host.ravel()[:n].reshape(shape)
+            out.append(host)
+        return out
+
+    def _place_slot(self, slot, nat_leaves):
+        """Place natural-shape (host) state leaves into the CURRENT
+        layout: flat zero-padded dp-sharded when the step shards and
+        every leaf is weight-shaped (the ``create_state_flat``
+        elementwise contract), replicated otherwise.  Updates the
+        per-slot layout flag."""
+        shape = tuple(self._shard_meta[slot])
+        if self._shard_n and all(tuple(onp.shape(l)) == shape
+                                 for l in nat_leaves):
+            self._shard_slots[slot] = True
+            self._opt_states[slot] = [
+                self._shard_put(jnp.asarray(l)) for l in nat_leaves]
+            return
+        self._shard_slots[slot] = False
+        wdev = None
+        i = self._trainable[slot]
+        devs = getattr(self._params[i].data()._data, "devices", None)
+        if devs is not None and self._params[i].data()._data.committed:
+            wdev = next(iter(self._params[i].data()._data.devices()))
+        self._opt_states[slot] = [
+            jax.device_put(jnp.asarray(l), wdev) if wdev is not None
+            else jnp.asarray(l) for l in nat_leaves]
+
+    def reshard(self, mesh):
+        """Re-form this step onto a new mesh (elastic recovery: the dp
+        extent changed under us).  Parameters are re-placed replicated
+        on the survivors' mesh and every ZeRO state leaf — the fp32
+        master included — migrates through its natural shape onto the
+        new flat zero-padded dp extent, bitwise-preserved (byte
+        movement only, no arithmetic).  The jit cache is invalidated;
+        the next call recompiles against the new layout and training
+        resumes mid-epoch.  Returns the bytes moved."""
+        naturals = [self._materialize_slot(slot)
+                    for slot in range(len(self._opt_states))]
+        self._mesh = mesh
+        self._shard_n = self._resolve_shard_optimizer(self._shard_knob)
+        moved = 0
+        repl = self._shard_sharding(replicated=True) \
+            if mesh is not None else None
+        with autograd.pause():
+            for p in self._params:
+                host = onp.asarray(p._data._data)
+                moved += host.nbytes
+                p._data._data = jax.device_put(host, repl) \
+                    if repl is not None else jnp.asarray(host)
+        for slot, nat in enumerate(naturals):
+            self._place_slot(slot, nat)
+            moved += sum(int(l.nbytes) for l in nat)
+        for slot, i in enumerate(self._trainable):
+            if self._mp_slots[slot]:
+                # the re-placed weight is a NEW array object; without
+                # this the next dispatch's master-resync would rebuild
+                # the fp32 master from the half-width weight, rounding
+                # away exactly the precision the master exists to keep
+                self._mp_written[slot] = self._params[i]._data._data
+        # device-resident carries migrate off the old mesh; the lr
+        # vector re-uploads lazily
+        if self._t_dev is not None:
+            self._t_dev = jnp.asarray(onp.asarray(self._t_dev))
+        if self._rng_dev is not None:
+            self._rng_dev = jnp.asarray(onp.asarray(self._rng_dev))
+        self._lrs_key = None
+        self._lrs_dev = None
+        self._cache.clear()
+        self._report_shard_layout()
+        return moved
+
+    def checkpoint_state(self):
+        """Snapshot for ``checkpoint.CheckpointManager`` — device-side
+        COPIES of the param/state arrays (async dispatch, no host
+        sync): the train step donates its buffers, so a
+        reference-only snapshot would race the next step's donation
+        and read freed memory.  All copies run as ONE jitted
+        ``optimization_barrier`` program (bit-exact identity that
+        cannot alias its inputs; per-array ``.copy()`` dispatch
+        overhead would dominate) ordered before the donation by the
+        runtime; the writer thread does the host transfer at its
+        leisure."""
+        vals = [p._data._data for p in self._params]
+        for leaves in self._opt_states:
+            vals.extend(leaves)
+        if self._ckpt_copier is None:
+            # retraces automatically when shapes/shardings move
+            # (reshard): the cache key is jit's own
+            self._ckpt_copier = jax.jit(
+                lambda xs: jax.lax.optimization_barrier(xs))
+        vals = list(self._ckpt_copier(vals))
+        params, vals = vals[:len(self._params)], vals[len(self._params):]
+        slots = []
+        for slot, leaves in enumerate(self._opt_states):
+            copies, vals = vals[:len(leaves)], vals[len(leaves):]
+            slots.append({"leaves": copies,
+                          "sharded": bool(self._shard_slots[slot]),
+                          "shape": tuple(self._shard_meta[slot]),
+                          "mp": bool(self._mp_slots[slot])})
+        # params/slots are POSITIONAL in the net's GRAPH order: gluon's
+        # global auto-naming counters make raw names differ between
+        # otherwise-identical nets, and name-SORTED order (self._params)
+        # flips when a counter crosses a digit boundary (dense9_ sorts
+        # after dense10_) — graph order is architecture-stable.  Names
+        # ride along as metadata only.
+        order = self._param_order()
+        slot_rank = {pi: k for k, pi in enumerate(order)}
+        slot_order = sorted(range(len(slots)),
+                            key=lambda s: slot_rank[self._trainable[s]])
+        return {"step": int(self._t), "dp": int(self._shard_n or 1),
+                "params": [params[i] for i in order],
+                "param_names": [self._params[i].name for i in order],
+                "slots": [slots[s] for s in slot_order]}
+
+    def _param_order(self):
+        """Canonical checkpoint permutation: position k -> index into
+        ``self._params`` of the k-th parameter in the net's GRAPH
+        (insertion) order — stable across processes regardless of
+        where gluon's auto-naming counters stand.  Both save and load
+        apply the same rule, so positional payloads align between
+        identically-structured nets."""
+        try:
+            rank = {n: i for i, n in
+                    enumerate(self._net.collect_params().keys())}
+        except Exception:
+            return list(range(len(self._params)))
+        return sorted(range(len(self._params)),
+                      key=lambda i: rank.get(self._params[i].name, i))
+
+    def load_checkpoint_state(self, state):
+        """Restore a checkpoint saved at ANY world size: natural-shape
+        leaves re-shard onto this step's current layout
+        (``_place_slot``), parameters re-place replicated, and the
+        step/optimizer clocks resume where the checkpoint stopped.
+        The RNG stream is NOT part of the checkpoint (re-seed with
+        ``mx.random.seed`` for bit-reproducible dropout)."""
+        from ..base import MXNetError
+        order = self._param_order()
+        # validate EVERYTHING before mutating anything: a caller that
+        # catches a mismatch error must find the step exactly as it
+        # was, never half-restored (checkpoint weights over stale
+        # optimizer state is silent corruption)
+        if len(state["params"]) != len(self._params):
+            raise MXNetError(
+                "checkpoint has %d parameters, step has %d"
+                % (len(state["params"]), len(self._params)))
+        if len(state["slots"]) != len(self._opt_states):
+            raise MXNetError(
+                "checkpoint has %d optimizer slots, step has %d"
+                % (len(state["slots"]), len(self._opt_states)))
+        for k, arr in enumerate(state["params"]):
+            p = self._params[order[k]]
+            if tuple(onp.shape(arr)) != tuple(p._data.shape):
+                raise MXNetError(
+                    "checkpoint parameter %r has shape %s, step "
+                    "expects %s" % (p.name, tuple(onp.shape(arr)),
+                                    tuple(p._data.shape)))
+        repl = self._shard_sharding(replicated=True) \
+            if self._mesh is not None else None
+        with autograd.pause():
+            for k, arr in enumerate(state["params"]):
+                p = self._params[order[k]]
+                val = jnp.asarray(onp.asarray(arr))
+                p._data._data = jax.device_put(val, repl) \
+                    if repl is not None else val
+        slot_rank = {pi: k for k, pi in enumerate(order)}
+        slot_order = sorted(range(len(self._opt_states)),
+                            key=lambda s: slot_rank[self._trainable[s]])
+        for k, rec in enumerate(state["slots"]):
+            self._place_slot(slot_order[k],
+                             [onp.asarray(l) for l in rec["leaves"]])
+        for slot, i in enumerate(self._trainable):
+            if self._mp_slots[slot]:
+                # master restored from the checkpoint IS the truth —
+                # suppress the dispatch-time resync from the half weight
+                self._mp_written[slot] = self._params[i]._data._data
+        self._t = int(state["step"])
+        self._opt.num_update = max(self._opt.num_update, self._t)
+        self._t_dev = None       # next dispatch resumes at t+1
+        self._lrs_key = None
+        self._lrs_dev = None
+        self._report_shard_layout()
 
     # ------------------------------------------------------------------
     def __call__(self, data, label):

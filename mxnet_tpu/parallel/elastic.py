@@ -1,0 +1,235 @@
+"""Elastic, preemption-tolerant training: detect → re-form → re-shard → resume.
+
+Production TPU fleets lose chips; the reference's answer was ps-lite
+heartbeat tracking + job restart (``include/mxnet/kvstore.h:353``
+get_num_dead_node), TensorFlow's (arxiv 1605.08695) is periodic
+checkpoints + cluster re-formation.  This module composes the
+primitives previous PRs built into the live half of that protocol:
+
+* **detect** — the PR-5 KV heartbeat liveness layer
+  (``kvstore.KVStoreTPU.num_dead_node`` over ``mxtpu/hb/<rank>``
+  records) polled through :func:`kv_retry` — bounded exponential
+  backoff + jitter, so a coordinator serving a barrier (or a chaos
+  ``kv_stall``/``kv_garble`` fault) reads as "retry", not "everyone
+  died";
+* **re-form** — a fresh device mesh over the survivors
+  (``mesh.device_mesh``), installed process-wide;
+* **re-shard** — the flat zero-padded ZeRO optimizer state (fp32
+  master included) migrates onto the new dp extent via
+  ``DataParallelStep.reshard`` / ``Trainer.reshard`` — pure byte
+  movement through natural shapes, bitwise-preserving;
+* **resume** — the train step's jit cache is invalidated and training
+  continues mid-epoch, no restart.
+
+Shards that died WITH a worker cannot be re-formed from survivors —
+that tier of failure restores from the async atomic checkpoints in
+``mxnet_tpu.checkpoint`` (the manifest is world-size keyed, so the
+restarted job may be smaller).  Joined workers are detected and
+journaled; growing the mesh goes through the same checkpoint boundary
+(jax cannot re-initialize a live distributed client).
+
+Every transition journals through telemetry (``elastic/detect``,
+``elastic/reshard``) for the ``tools/parse_log.py --jsonl`` census.
+Protocol walk-through: docs/ROBUSTNESS.md.
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+
+import jax
+
+from .. import telemetry
+from ..base import MXNetError
+from .mesh import device_mesh, get_mesh, set_mesh
+
+__all__ = ["ElasticContext", "kv_retry"]
+
+
+_default_rng = None
+
+
+def _process_rng():
+    """Default jitter stream, seeded per PROCESS: ranks that share a
+    seed draw identical jitter and retry in lockstep — the stampede
+    the jitter exists to prevent.  One module-level instance so
+    successive calls keep advancing the stream."""
+    global _default_rng
+    if _default_rng is None:
+        _default_rng = random.Random(0x5EED ^ os.getpid())
+    return _default_rng
+
+
+def kv_retry(fn, retries=5, base=0.05, cap=2.0, jitter=0.5, rng=None,
+             sleep=time.sleep):
+    """Run a KV coordinator op with bounded exponential backoff +
+    jitter: attempt k sleeps ``min(cap, base * 2**k)`` scaled by a
+    deterministic jitter draw (de-synchronizing N workers hammering a
+    recovering coordinator), up to ``retries`` attempts.  The last
+    failure is re-raised — a coordinator that stays unreachable is a
+    real event the caller must classify, never a silent zero."""
+    rng = rng if rng is not None else _process_rng()
+    retries = max(1, int(retries))
+    last = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except Exception as e:          # noqa: BLE001 — RPC layer varies
+            last = e
+            telemetry.inc("elastic.kv_retries")
+            if attempt + 1 >= retries:
+                break
+            delay = min(float(cap), float(base) * (2.0 ** attempt))
+            sleep(delay * (1.0 + float(jitter) * rng.random()))
+    raise last
+
+
+class ElasticContext:
+    """Watches liveness, re-forms the mesh among survivors, re-shards
+    ZeRO state onto the new dp extent.
+
+    ::
+
+        ctx = ElasticContext(step, kvstore=kv)
+        for i, (x, y) in enumerate(batches):
+            ev = ctx.maybe_recover(step=i)     # detect + re-form
+            loss = step(x, y)                  # resumes mid-epoch
+
+    ``target`` is a ``DataParallelStep`` or ``Trainer`` (anything with
+    a ``reshard(mesh)`` method).  Liveness defaults to the kvstore's
+    ``num_dead_node``; pass ``liveness=`` (a callable returning the
+    dead-peer count) to watch something else — the chaos tests drive
+    exactly that seam.
+    """
+
+    def __init__(self, target=None, kvstore=None, liveness=None,
+                 min_workers=1, world_size=None, poll_interval=0.0,
+                 retries=5, backoff_base=0.05, backoff_cap=2.0,
+                 jitter=0.5, seed=None):
+        if kvstore is None and liveness is None and target is None:
+            raise MXNetError("ElasticContext needs a target, a kvstore "
+                             "or a liveness callable")
+        self._target = target
+        self._kv = kvstore
+        self._liveness = liveness
+        self._min_workers = int(min_workers)
+        # a liveness probe is a coordinator RPC: production loops that
+        # call poll()/maybe_recover() every step should throttle it
+        # (poll_interval of a fraction of the heartbeat window —
+        # detection latency is bounded by the window anyway); 0 probes
+        # on every call
+        self._poll_interval = float(poll_interval)
+        self._last_probe = None
+        self._retries = int(retries)
+        self._base = float(backoff_base)
+        self._cap = float(backoff_cap)
+        self._jitter = float(jitter)
+        # deterministic per-rank jitter stream: N workers retrying the
+        # same flap spread out instead of stampeding in lockstep
+        if seed is None:
+            seed = 0x5EED + (kvstore.rank if kvstore is not None else 0)
+        self._rng = random.Random(seed)
+        # the starting world: worker processes when a kvstore
+        # coordinates them; otherwise the target's mesh extent (the
+        # single-controller case, where "workers" are mesh devices)
+        if world_size is not None:
+            self._world0 = int(world_size)
+        elif kvstore is not None:
+            self._world0 = int(kvstore.num_workers)
+        else:
+            mesh = getattr(target, "_mesh", None) or get_mesh()
+            self._world0 = int(mesh.size) if mesh is not None \
+                else max(1, jax.process_count())
+        self._dead = 0
+
+    # -- state ----------------------------------------------------------
+    @property
+    def world(self):
+        """Currently-believed live worker count."""
+        return self._world0 - self._dead
+
+    def _probe(self):
+        if self._liveness is not None:
+            return int(self._liveness())
+        return int(self._kv.num_dead_node())
+
+    # -- detect ----------------------------------------------------------
+    def poll(self, step=None):
+        """One liveness probe (with backoff+jitter around coordinator
+        flaps).  Returns an event dict on a membership change —
+        ``{"kind": "departed"|"joined"|"coordinator_lost", ...}`` — or
+        None when the world is unchanged (or the probe is throttled by
+        ``poll_interval``).  Every change journals an
+        ``elastic/detect`` event."""
+        now = time.monotonic()
+        if self._poll_interval and self._last_probe is not None \
+                and now - self._last_probe < self._poll_interval:
+            return None
+        self._last_probe = now
+        try:
+            dead = kv_retry(self._probe, retries=self._retries,
+                            base=self._base, cap=self._cap,
+                            jitter=self._jitter, rng=self._rng)
+        except Exception as e:          # noqa: BLE001
+            telemetry.inc("elastic.coordinator_lost")
+            telemetry.event("elastic", "detect", step=step,
+                            reason="coordinator_unreachable",
+                            error=repr(e), world_from=self.world,
+                            world_to=self.world)
+            return {"kind": "coordinator_lost", "error": e, "step": step}
+        if dead == self._dead:
+            return None
+        kind = "departed" if dead > self._dead else "joined"
+        ev = {"kind": kind, "step": step, "n_dead": dead,
+              "world_from": self._world0 - self._dead,
+              "world_to": self._world0 - dead}
+        telemetry.inc("elastic.detections")
+        telemetry.event("elastic", "detect", step=step, change=kind,
+                        n_dead=dead, world_from=ev["world_from"],
+                        world_to=ev["world_to"])
+        self._dead = dead
+        if self.world < self._min_workers:
+            raise MXNetError(
+                "elastic: %d live workers < min_workers=%d — restart "
+                "from the last checkpoint manifest with a smaller world"
+                % (self.world, self._min_workers))
+        return ev
+
+    # -- re-form + re-shard ----------------------------------------------
+    def reform(self, devices=None, mesh=None, axis_names=("dp",),
+               step=None):
+        """Re-form the mesh among survivors and re-shard the target's
+        state onto it.  ``devices`` (or an explicit ``mesh``) names the
+        survivors; default is every currently-addressable local device.
+        Journals ``elastic/reshard`` with the world transition, bytes
+        moved and duration.  Returns the new mesh."""
+        if self._target is None:
+            raise MXNetError("ElasticContext has no target to reshard")
+        if mesh is None:
+            devices = list(devices) if devices is not None \
+                else list(jax.local_devices())
+            shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+            mesh = device_mesh(shape, axis_names, devices=devices)
+        old = getattr(self._target, "_mesh", None) or get_mesh()
+        old_n = int(old.size) if old is not None else 1
+        t0 = time.perf_counter()
+        moved = self._target.reshard(mesh)
+        set_mesh(mesh)
+        telemetry.inc("elastic.reshards")
+        telemetry.event("elastic", "reshard", step=step,
+                        world_from=old_n, world_to=int(mesh.size),
+                        bytes=int(moved or 0),
+                        dur_ms=round((time.perf_counter() - t0) * 1e3, 3))
+        return mesh
+
+    def maybe_recover(self, devices=None, step=None):
+        """poll() + reform() in one call — the per-step guard a training
+        loop runs.  Only a departure triggers re-formation; joins and
+        coordinator loss are reported for the caller to act on (grow /
+        restore at the next checkpoint boundary)."""
+        ev = self.poll(step=step)
+        if ev is not None and ev["kind"] == "departed" \
+                and self._target is not None:
+            ev["mesh"] = self.reform(devices=devices, step=step)
+        return ev

@@ -340,8 +340,12 @@ class Symbol:
                           indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # atomic (tmp + os.replace): model.save_checkpoint must never
+        # leave a torn symbol json next to a good params file
+        from ..checkpoint import atomic_path
+        with atomic_path(fname) as tmp:
+            with open(tmp, "w") as f:
+                f.write(self.tojson())
 
     # -- evaluation -----------------------------------------------------
     def eval_imperative(self, arg_dict):

@@ -122,6 +122,18 @@ def test_hard_failures_gate_telemetry_overhead(bench):
     assert not bench._hard_failures([good])
 
 
+def test_hard_failures_gate_checkpoint_overhead(bench):
+    """Async checkpointing's 2% overhead budget at the default cadence
+    is a hard bench failure, mirroring the telemetry gate."""
+    bad = {"bench": "checkpoint_overhead", "overhead_pct": 4.2,
+           "overhead_ok": False, "every_n_steps": 32}
+    assert any("checkpoint overhead" in h
+               for h in bench._hard_failures([bad]))
+    good = {"bench": "checkpoint_overhead", "overhead_pct": 0.9,
+            "overhead_ok": True, "every_n_steps": 32}
+    assert not bench._hard_failures([good])
+
+
 def test_attention_bench_records_dispatcher_choice(bench):
     """The attention sweep ships the dispatcher's kernel choice (and its
     block tuning + tuner provenance) per shape so BENCH rounds can audit

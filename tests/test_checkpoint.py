@@ -1,0 +1,350 @@
+"""Async atomic sharded checkpoints (mxnet_tpu/checkpoint.py).
+
+Covers the durability tier of the elastic protocol: the async writer's
+clean thread lifecycle (under the runtime lock-order sanitizer vs the
+package static graph — the PR-7 static-vs-runtime pattern), tmp +
+os.replace atomicity under the chaos ``checkpoint_write_crash`` fault
+(manager files, ``nd.save``, ``model.save_checkpoint``,
+``Trainer.save_states``), the manifest commit point, and the headline
+contract: a checkpoint saved at one world size restores into a
+DIFFERENT world size with the materialized optimizer state bitwise
+equal.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, gluon, parallel, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.parallel import chaos
+
+_X = onp.random.RandomState(0).randn(16, 9).astype("float32")
+_Y = onp.random.RandomState(1).randint(0, 4, 16).astype("float32")
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _mesh(n):
+    return parallel.device_mesh((n,), ("dp",), devices=jax.devices()[:n])
+
+
+def _build_step(mesh, optimizer=None, bf16=False):
+    onp.random.seed(42)
+    mx.random.seed(42)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(7, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(_X))
+    if bf16:
+        net.cast("bfloat16")
+    L = gloss.SoftmaxCrossEntropyLoss()
+    opt = optimizer() if optimizer else mx.optimizer.Adam(
+        learning_rate=1e-3)
+    step = parallel.DataParallelStep(net, lambda o, l: L(o, l), opt,
+                                     mesh=mesh, shard_optimizer=True)
+    return net, step
+
+
+def _run(step, k):
+    return [float(step(mx.nd.array(_X), mx.nd.array(_Y)).asscalar())
+            for _ in range(k)]
+
+
+def _canonical_slots(st):
+    """Slot indices in the net's graph order — the two steps' local
+    name-sorted orders can differ when gluon's auto-naming counters
+    straddle a digit boundary (the exact hazard checkpoint_state keys
+    around)."""
+    order = st._param_order()
+    rank = {pi: k for k, pi in enumerate(order)}
+    return sorted(range(len(st._opt_states)),
+                  key=lambda s: rank[st._trainable[s]])
+
+
+def _assert_states_bitwise(st_a, st_b):
+    assert len(st_a._opt_states) == len(st_b._opt_states)
+    for qa, qb in zip(_canonical_slots(st_a), _canonical_slots(st_b)):
+        for la, lb in zip(st_a._materialize_slot(qa),
+                          st_b._materialize_slot(qb)):
+            onp.testing.assert_array_equal(la, lb)
+    for ia, ib in zip(st_a._param_order(), st_b._param_order()):
+        onp.testing.assert_array_equal(
+            onp.asarray(st_a._params[ia]._data._data),
+            onp.asarray(st_b._params[ib]._data._data))
+
+
+# ---------------------------------------------------------------------------
+# round-trips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sync_roundtrip_same_world_bitwise(tmp_path):
+    # slow: same-world round-trip is a strict subset of the
+    # changed-world acceptance test below, which stays tier-1
+    net_a, st_a = _build_step(_mesh(8))
+    _run(st_a, 3)
+    mgr = checkpoint.CheckpointManager(str(tmp_path), st_a,
+                                       async_write=False)
+    mgr.save()
+    net_b, st_b = _build_step(_mesh(8))
+    assert checkpoint.restore_latest(str(tmp_path), st_b) == 3
+    _assert_states_bitwise(st_a, st_b)
+    # training continues identically from the restored state
+    la, lb = _run(st_a, 2), _run(st_b, 2)
+    onp.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-7)
+
+
+def test_restore_into_smaller_world_bitwise(tmp_path):
+    """The acceptance headline: a 4-way checkpoint restores into a
+    2-way world with the materialized optimizer state (fp32 master
+    included) bitwise equal — re-sharding on load is byte movement,
+    never arithmetic."""
+    mk = lambda: mx.optimizer.Adam(learning_rate=1e-3,  # noqa: E731
+                                   multi_precision=True)
+    net_a, st_a = _build_step(_mesh(4), optimizer=mk, bf16=True)
+    _run(st_a, 3)
+    checkpoint.CheckpointManager(str(tmp_path), st_a,
+                                 async_write=False).save()
+    net_b, st_b = _build_step(_mesh(2), optimizer=mk, bf16=True)
+    assert checkpoint.restore_latest(str(tmp_path), st_b) == 3
+    assert st_b._shard_n == 2
+    leaf = st_b._opt_states[0][0]
+    assert leaf.shape[0] % 2 == 0    # re-sharded to the new extent
+    _assert_states_bitwise(st_a, st_b)
+    # journal records the world transition
+    ev = [e for e in telemetry.snapshot(events=256)["events"]
+          if e["kind"] == "ckpt" and e["name"] == "restore"]
+    assert ev and ev[-1]["world_from"] == 4 and ev[-1]["world_to"] == 2
+    # and the restored job trains on (same math at any dp extent)
+    la, lb = _run(st_a, 2), _run(st_b, 2)
+    onp.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_restore_into_larger_world_bitwise(tmp_path):
+    net_a, st_a = _build_step(_mesh(2))
+    _run(st_a, 2)
+    checkpoint.CheckpointManager(str(tmp_path), st_a,
+                                 async_write=False).save()
+    net_b, st_b = _build_step(_mesh(8))
+    checkpoint.restore_latest(str(tmp_path), st_b)
+    assert st_b._shard_n == 8
+    _assert_states_bitwise(st_a, st_b)
+
+
+def test_async_cadence_hook_and_manifest(tmp_path):
+    """attach(): every K-th step enqueues a snapshot; the manifest
+    always points at a COMPLETE checkpoint; donation of the live
+    buffers cannot corrupt an in-flight snapshot (device-side copies);
+    pruning keeps the newest dirs."""
+    net, st = _build_step(_mesh(8))
+    w0 = telemetry.counter("ckpt.writes")
+    mgr = checkpoint.CheckpointManager(str(tmp_path), st,
+                                       every_n_steps=2, keep=2)
+    mgr.attach()
+    try:
+        _run(st, 6)
+        assert mgr.flush(30.0)
+    finally:
+        mgr.close()
+    assert mgr.stats()["last_error"] is None
+    man = checkpoint.read_manifest(str(tmp_path))
+    assert man is not None and man["step"] == 6 and man["dp"] == 8
+    assert telemetry.counter("ckpt.writes") - w0 >= 1
+    stepdirs = sorted(d for d in os.listdir(str(tmp_path))
+                      if d.startswith("step-"))
+    assert man["dir"] in stepdirs and len(stepdirs) <= 2
+    ev = [e for e in telemetry.snapshot(events=256)["events"]
+          if e["kind"] == "ckpt" and e["name"] == "write"]
+    assert ev and ev[-1]["bytes"] > 0 and ev[-1]["dur_ms"] >= 0
+
+
+@pytest.mark.slow
+def test_async_skip_when_write_in_flight(tmp_path, monkeypatch):
+    """Backpressure: a snapshot arriving while the queue is full is
+    dropped (counted + journaled), never queued behind — training must
+    not stall on the disk.  The writer is slowed deterministically so
+    the 2-deep queue is guaranteed full by the 4th save."""
+    net, st = _build_step(_mesh(8))
+    orig = checkpoint.CheckpointManager._write
+
+    def slow_write(self, snap, t_enq):
+        time.sleep(0.2)
+        return orig(self, snap, t_enq)
+
+    monkeypatch.setattr(checkpoint.CheckpointManager, "_write",
+                        slow_write)
+    mgr = checkpoint.CheckpointManager(str(tmp_path), st)
+    s0 = telemetry.counter("ckpt.skipped")
+    results = [mgr.save() for _ in range(5)]
+    skipped = results.count(False)
+    assert skipped >= 1
+    assert mgr.flush(30.0)
+    mgr.close()
+    assert telemetry.counter("ckpt.skipped") - s0 == skipped
+    ev = [e for e in telemetry.snapshot(events=256)["events"]
+          if e["kind"] == "ckpt" and e["name"] == "skipped"]
+    assert ev and ev[-1]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# atomicity under the chaos write-crash fault
+# ---------------------------------------------------------------------------
+
+def test_manifest_survives_write_crash(tmp_path):
+    """A crash mid-checkpoint (after some shard files, before the
+    manifest flip) leaves the PREVIOUS manifest in force and the
+    previous checkpoint fully restorable."""
+    net_a, st_a = _build_step(_mesh(4))
+    _run(st_a, 2)
+    mgr = checkpoint.CheckpointManager(str(tmp_path), st_a,
+                                       async_write=False)
+    mgr.save()
+    good = checkpoint.read_manifest(str(tmp_path))
+    _run(st_a, 2)
+    chaos.install("checkpoint_write_crash", times=1)
+    with pytest.raises(chaos.ChaosError):
+        mgr.save()
+    assert checkpoint.read_manifest(str(tmp_path)) == good
+    net_b, st_b = _build_step(_mesh(4))
+    assert checkpoint.restore_latest(str(tmp_path), st_b) == 2
+    # async mode: same crash is journaled, training never sees it
+    chaos.install("checkpoint_write_crash", times=1)
+    f0 = telemetry.counter("ckpt.write_failures")
+    mgr2 = checkpoint.CheckpointManager(str(tmp_path), st_a)
+    mgr2.save()
+    assert mgr2.flush(30.0)
+    mgr2.close()
+    assert telemetry.counter("ckpt.write_failures") - f0 == 1
+    assert mgr2.stats()["last_error"] is not None
+    assert checkpoint.read_manifest(str(tmp_path)) == good
+
+
+def test_nd_save_atomic_under_write_crash(tmp_path):
+    """Satellite: ``nd.save`` (the .params writer under every gluon /
+    model checkpoint) goes tmp + os.replace — the crash window leaves
+    the previous file intact and parseable, and no torn file at the
+    target path."""
+    path = str(tmp_path / "w.params")
+    mx.nd.save(path, {"a": mx.nd.array([1.0, 2.0])})
+    chaos.install("checkpoint_write_crash", times=1)
+    with pytest.raises(chaos.ChaosError):
+        mx.nd.save(path, {"a": mx.nd.array([9.0, 9.0])})
+    out = mx.nd.load(path)
+    onp.testing.assert_array_equal(out["a"].asnumpy(), [1.0, 2.0])
+    assert not [f for f in os.listdir(str(tmp_path)) if ".tmp." in f]
+    # fresh-path crash: nothing appears at all (no torn new file)
+    p2 = str(tmp_path / "fresh.params")
+    chaos.install("checkpoint_write_crash", times=1)
+    with pytest.raises(chaos.ChaosError):
+        mx.nd.save(p2, {"a": mx.nd.array([1.0])})
+    assert not os.path.exists(p2)
+
+
+def test_model_save_checkpoint_atomic(tmp_path):
+    """Satellite: model.save_checkpoint's params AND symbol-json
+    writes survive an injected mid-write crash with the previous
+    checkpoint intact."""
+    from mxnet_tpu import model as model_mod
+    from mxnet_tpu import symbol as sym
+    x = sym.Variable("data")
+    net = sym.FullyConnected(x, num_hidden=3, name="fc")
+    prefix = str(tmp_path / "ck")
+    arg = {"fc_weight": mx.nd.array(onp.ones((3, 4), "float32")),
+           "fc_bias": mx.nd.array(onp.zeros((3,), "float32"))}
+    model_mod.save_checkpoint(prefix, 1, net, arg, {})
+    chaos.install("checkpoint_write_crash", times=1)
+    with pytest.raises(chaos.ChaosError):
+        model_mod.save_checkpoint(
+            prefix, 1, net,
+            {k: mx.nd.array(onp.full_like(v.asnumpy(), 7.0))
+             for k, v in arg.items()}, {})
+    _, arg2, _ = model_mod.load_checkpoint(prefix, 1)
+    onp.testing.assert_array_equal(arg2["fc_weight"].asnumpy(),
+                                   arg["fc_weight"].asnumpy())
+
+
+def test_trainer_save_states_atomic(tmp_path):
+    """Satellite: Trainer.save_states is tmp + os.replace on both the
+    updater and kvstore paths."""
+    onp.random.seed(0)
+    net = nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(_X))
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    with mx.autograd.record():
+        loss = L(net(mx.nd.array(_X)), mx.nd.array(_Y))
+    loss.backward()
+    tr.step(16)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    good = open(f, "rb").read()
+    tr.step(16)
+    chaos.install("checkpoint_write_crash", times=1)
+    with pytest.raises(chaos.ChaosError):
+        tr.save_states(f)
+    assert open(f, "rb").read() == good      # previous file intact
+    tr.load_states(f)                        # and still loadable
+
+
+# ---------------------------------------------------------------------------
+# writer-thread concurrency contracts (PR-7 static-vs-runtime pattern)
+# ---------------------------------------------------------------------------
+
+def test_writer_thread_lifecycle_and_lock_order(tmp_path,
+                                                package_lock_graph):
+    """The async writer under the LockOrderSanitizer vs the package
+    static lock graph: no cycles, observed edges a subset of the
+    static model, and close() joins promptly (stop Event + join — the
+    conc-thread-lifecycle contract)."""
+    from tools.lint.runtime_lockorder import LockOrderSanitizer
+    net, st = _build_step(_mesh(8))
+    with LockOrderSanitizer() as san:
+        mgr = checkpoint.CheckpointManager(str(tmp_path), st,
+                                           every_n_steps=2)
+        mgr.attach()
+        _run(st, 4)
+        assert mgr.flush(30.0)
+        t = mgr._thread
+        t0 = time.monotonic()
+        mgr.close()
+        assert time.monotonic() - t0 < 5.0
+        assert t is not None and not t.is_alive()
+        mgr.close()                          # idempotent
+    san.assert_no_cycles()
+    san.assert_subgraph_of(package_lock_graph)
+
+
+def test_manager_errors_without_target(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), async_write=False)
+    with pytest.raises(MXNetError, match="no target"):
+        mgr.save()
+
+
+def test_read_manifest_tolerates_foreign_file(tmp_path):
+    assert checkpoint.read_manifest(str(tmp_path)) is None
+    (tmp_path / checkpoint.MANIFEST).write_text("not json {")
+    assert checkpoint.read_manifest(str(tmp_path)) is None
+    (tmp_path / checkpoint.MANIFEST).write_text(json.dumps([1, 2]))
+    assert checkpoint.read_manifest(str(tmp_path)) is None
+    with pytest.raises(MXNetError, match="manifest"):
+        checkpoint.restore_latest(str(tmp_path), None)

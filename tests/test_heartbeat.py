@@ -168,9 +168,10 @@ def test_kvstore_close_stops_publisher(publisher_env):
 
 
 def test_publisher_survives_flap_until_stopped(publisher_env):
-    """Transient coordinator failures must not kill the publisher (5
-    consecutive misses exit); recovery resumes publishing, and stop
-    still joins cleanly mid-flap."""
+    """Transient coordinator failures must not kill the publisher
+    (bounded backoff, MXNET_TPU_HEARTBEAT_RETRIES consecutive misses
+    before give-up); recovery resumes publishing, and stop still joins
+    cleanly mid-flap."""
     client = publisher_env
     client.fail_sets = True
     kvs._start_liveness_heartbeat()
@@ -184,6 +185,72 @@ def test_publisher_survives_flap_until_stopped(publisher_env):
     assert client.sets, "publisher did not recover from the flap"
     kvs._stop_liveness_heartbeat()
     assert not t.is_alive()
+
+
+def test_publisher_backoff_giveup_journals_once(publisher_env,
+                                                monkeypatch):
+    """Satellite (elastic hardening): a coordinator that stays dead
+    past the bounded retry budget makes the publisher exit — with
+    every miss counted in ``elastic.heartbeat_misses`` and EXACTLY ONE
+    ``elastic/publisher_giveup`` journal event — instead of the old
+    hard 5-consecutive-miss silent exit."""
+    from mxnet_tpu import telemetry
+    client = publisher_env
+    client.fail_sets = True
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_RETRIES", "2")
+    m0 = telemetry.counter("elastic.heartbeat_misses")
+    kvs._start_liveness_heartbeat()
+    t = kvs._hb_state["thread"]
+    deadline = time.time() + 10
+    while t.is_alive() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not t.is_alive(), "publisher did not give up after the budget"
+    assert telemetry.counter("elastic.heartbeat_misses") - m0 == 2
+    ev = [e for e in telemetry.snapshot(events=512)["events"]
+          if e["kind"] == "elastic" and e["name"] == "publisher_giveup"]
+    assert len(ev) == 1 and ev[0]["misses"] == 2 and ev[0]["rank"] == 0
+
+
+def test_publisher_backoff_spacing(publisher_env, monkeypatch):
+    """Retries back off exponentially (with jitter) instead of
+    hammering a struggling coordinator at the fixed beat interval:
+    with retries=3 the give-up takes at least interval + 2*interval
+    of backoff waits."""
+    client = publisher_env
+    client.fail_sets = True
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_RETRIES", "3")
+    kvs._start_liveness_heartbeat()
+    t = kvs._hb_state["thread"]
+    t0 = time.time()
+    deadline = t0 + 15
+    while t.is_alive() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not t.is_alive()
+    # interval = window/4 = 0.5s: miss1 waits >=0.5, miss2 waits >=1.0,
+    # miss3 gives up immediately -> at least ~1.5s total
+    assert time.time() - t0 >= 1.4, "no backoff between retries"
+
+
+def test_publisher_drop_heartbeat_chaos_fault(publisher_env):
+    """chaos drop_heartbeat: the worker stays alive but publishes
+    nothing (a partition, as peers see it); clearing the fault resumes
+    beats — the seam the multiprocess chaos matrix drives."""
+    from mxnet_tpu.parallel import chaos
+    client = publisher_env
+    chaos.install("drop_heartbeat", rank=0)
+    try:
+        kvs._start_liveness_heartbeat()
+        t = kvs._hb_state["thread"]
+        time.sleep(0.3)
+        assert t.is_alive() and not client.sets, \
+            "dropped beats must not reach the coordinator"
+        chaos.clear("drop_heartbeat")
+        deadline = time.time() + 5
+        while not client.sets and time.time() < deadline:
+            time.sleep(0.01)
+        assert client.sets, "publisher did not resume after the fault"
+    finally:
+        chaos.clear()
 
 
 def test_num_dead_node_uses_heartbeat_fallback(monkeypatch):

@@ -470,7 +470,10 @@ def test_changed_mode_matches_full_run(package_scan):
     # the closure pulls in importers of collectives.py, but not the
     # whole package
     assert len(fast.files) < len(full.files)
-    assert elapsed < 10.0, "changed-mode run took %.1fs" % elapsed
+    # budget 12 s (was 10): PR 11's checkpoint.py imports collectives
+    # (padded_size), growing this file's reverse-dependency closure by
+    # one threaded module the conc checkers walk
+    assert elapsed < 12.0, "changed-mode run took %.1fs" % elapsed
 
 
 def test_reverse_dependency_closure(tmp_path):

@@ -99,6 +99,46 @@ def test_parse_log_jsonl_roundtrip(tmp_path):
     assert "shape[0]: 4 -> 8" in out
 
 
+def test_parse_log_elastic_ckpt_census_roundtrip(tmp_path):
+    """Round-trip: elastic/checkpoint journal events (the recovery
+    protocol's detect/reshard/write/restore transitions) -> parse_log
+    --jsonl census table with step, world-size transition, bytes and
+    duration."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    from mxnet_tpu import telemetry
+
+    telemetry.reset()
+    telemetry.event("elastic", "detect", step=12, change="departed",
+                    n_dead=1, world_from=8, world_to=7)
+    telemetry.event("elastic", "reshard", step=12, world_from=8,
+                    world_to=7, bytes=4096, dur_ms=3.25)
+    telemetry.event("ckpt", "write", step=10, world=8, bytes=2048,
+                    dur_ms=1.5, queued_ms=0.1)
+    telemetry.event("ckpt", "restore", step=10, world_from=8,
+                    world_to=2, bytes=2048, dur_ms=2.0)
+    telemetry.event("elastic", "publisher_giveup", rank=3, misses=8)
+    path = tmp_path / "metrics.jsonl"
+    telemetry.export_jsonl(str(path))
+    telemetry.reset()
+
+    with open(path) as f:
+        agg = parse_log.parse_jsonl(f)
+    ev = {e["event"]: e for e in agg["elastic"]}
+    assert ev["elastic/detect"]["world"] == "8->7"
+    assert ev["elastic/detect"]["detail"] == "departed"
+    assert ev["elastic/reshard"]["bytes"] == 4096
+    assert ev["elastic/reshard"]["dur_ms"] == 3.25
+    assert ev["ckpt/write"]["world"] == "8"
+    assert ev["ckpt/write"]["step"] == 10
+    assert ev["ckpt/restore"]["world"] == "8->2"
+    assert "elastic/publisher_giveup" in ev
+    out = parse_log.render_jsonl(agg)
+    assert "elastic/checkpoint journal census:" in out
+    assert "| elastic/reshard | 12 | 8->7 | 4096 | 3.25 |" in out
+    assert "| ckpt/restore | 10 | 8->2 | 2048 |" in out
+
+
 def test_parse_log_lint_report_rule_families():
     """--lint renders rules grouped by checker family — the sharding
     family lands in its own rows."""

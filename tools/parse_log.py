@@ -85,6 +85,7 @@ def parse_jsonl(lines):
     lockorder = []
     numerics = {}
     autotune = []
+    elastic = []
     lint_gate = None
     steps = 0
     for line in lines:
@@ -144,6 +145,26 @@ def parse_jsonl(lines):
                              "dtype": rec.get("dtype"),
                              "config": rec.get("config"),
                              "reason": rec.get("reason")})
+        elif kind in ("elastic", "ckpt"):
+            # elastic-transition / checkpoint journal events (one per
+            # detect/reshard/write/restore — mxnet_tpu.parallel.elastic
+            # + mxnet_tpu.checkpoint): the recovery-protocol census
+            w_from, w_to = rec.get("world_from"), rec.get("world_to")
+            if w_from is not None and w_to is not None \
+                    and w_from != w_to:
+                world = "%s->%s" % (w_from, w_to)
+            elif w_to is not None or w_from is not None:
+                world = str(w_to if w_to is not None else w_from)
+            else:
+                world = rec.get("world")
+                world = str(world) if world is not None else None
+            elastic.append({"event": "%s/%s" % (kind, rec.get("name")),
+                            "step": rec.get("step"),
+                            "world": world,
+                            "bytes": rec.get("bytes"),
+                            "dur_ms": rec.get("dur_ms"),
+                            "detail": rec.get("change") or rec.get("reason")
+                            or rec.get("error")})
         elif kind == "lint" and rec.get("name") == "gate":
             lint_gate = rec
         elif kind == "snapshot":
@@ -159,7 +180,8 @@ def parse_jsonl(lines):
     return {"spans": spans, "counters": counters, "gauges": gauges,
             "recompiles": recompiles, "steps": steps, "hbm": hbm,
             "lockorder": lockorder, "numerics": numerics,
-            "autotune": autotune, "lint_gate": lint_gate}
+            "autotune": autotune, "elastic": elastic,
+            "lint_gate": lint_gate}
 
 
 def _render_hbm(hbm, fmt="markdown"):
@@ -224,8 +246,34 @@ def render_jsonl(agg, fmt="markdown"):
     out.extend(_render_numerics(agg.get("numerics") or {}, fmt))
     out.extend(_render_autotune(agg.get("autotune") or [],
                                 agg.get("counters") or {}, fmt))
+    out.extend(_render_elastic(agg.get("elastic") or [], fmt))
     out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
+
+
+def _render_elastic(elastic, fmt="markdown"):
+    """Elastic/checkpoint journal census: one row per recovery-protocol
+    transition (elastic/detect, elastic/reshard, ckpt/write,
+    ckpt/restore, ...) with the step, world-size transition, bytes
+    moved and duration."""
+    if not elastic:
+        return []
+    header = ["event", "step", "world", "bytes", "ms", "detail"]
+    out = ["", "elastic/checkpoint journal census:"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+
+    def cell(v):
+        return "-" if v is None else str(v)
+
+    for e in elastic:
+        vals = [e["event"], cell(e.get("step")), cell(e.get("world")),
+                cell(e.get("bytes")), cell(e.get("dur_ms")),
+                cell(e.get("detail"))]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
 
 
 def _render_autotune(autotune, counters, fmt="markdown"):
