@@ -839,6 +839,109 @@ def bench_checkpoint_overhead(batch_size=256, hidden=512, iters=8,
             "ckpt_errors": stats["last_error"]}
 
 
+def bench_serving_latency(rates=(25.0, 100.0, 400.0), duration_s=2.0,
+                          feature=64, hidden=256, deadline_ms=500.0,
+                          batch_wait_ms=2.0):
+    """Open-loop serving latency through the continuous-batching
+    inference server (``mxnet_tpu.serve``): a small MLP served from
+    bucketed AOT executables, driven at ``rates`` arrival rates
+    (requests/s) with submissions on a FIXED schedule — open-loop, so a
+    slow server cannot slow the offered load and hide its own queueing.
+
+    Per rate: p50/p99 terminal latency over completed requests,
+    throughput, and the outcome census (results/timeouts/rejects).
+    HARD bench failures (_hard_failures):
+
+      * ``steady_state_recompiles > 0`` — the telemetry recompile
+        detector saw a serve executable compile during the load phase;
+        the bucketed-AOT contract is zero recompiles at steady state;
+      * ``p99 > 10 x p50`` at the LOWEST rate — an unloaded server with
+        a fat tail means a scheduling/dispatch bug, not queueing;
+      * any request with NO terminal outcome — the no-hangs invariant
+        is the server's whole robustness contract.
+    """
+    import numpy as onp
+    from mxnet_tpu import serve
+
+    rng = onp.random.RandomState(0)
+    w1 = rng.randn(feature, hidden).astype("float32") * 0.05
+    w2 = rng.randn(hidden, 16).astype("float32") * 0.05
+
+    def fn(x):
+        import jax.numpy as jnp
+        h = jnp.maximum(x @ jnp.asarray(w1), 0.0)
+        return h @ jnp.asarray(w2)
+
+    cfg = serve.ServeConfig(buckets=(1, 2, 4, 8, 16), max_queue=128,
+                            batch_wait_ms=batch_wait_ms,
+                            default_deadline_ms=deadline_ms,
+                            dispatch_timeout_ms=1000.0)
+    srv = serve.InferenceServer(fn, feature_shape=(feature,), config=cfg,
+                                name="serving_bench")
+    t0 = time.perf_counter()
+    srv.start()
+    startup_ms = (time.perf_counter() - t0) * 1e3
+    x = rng.randn(feature).astype("float32")
+    for _ in range(4):          # one warm dispatch before timing
+        srv.submit(x).outcome(timeout=2.0)
+
+    def pct(sorted_ms, p):
+        if not sorted_ms:
+            return None
+        idx = max(0, min(len(sorted_ms) - 1,
+                         int(round(p / 100.0 * len(sorted_ms))) - 1))
+        return round(sorted_ms[idx], 3)
+
+    legs = []
+    hangs = 0
+    for rate in rates:
+        n = max(8, int(rate * duration_s))
+        start = time.perf_counter()
+        handles = []
+        for i in range(n):
+            target = start + i / rate
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            handles.append(srv.submit(x, deadline_ms=deadline_ms))
+        outs = [h.outcome(timeout=deadline_ms / 1e3 + 2.0)
+                for h in handles]
+        elapsed = time.perf_counter() - start
+        kinds = {}
+        for o in outs:
+            k = o[0] if o is not None else "hang"
+            kinds[k] = kinds.get(k, 0) + 1
+        hangs += kinds.get("hang", 0)
+        lats = sorted(h.latency_ms() for h, o in zip(handles, outs)
+                      if o is not None and o[0] == "result")
+        legs.append({
+            "rate_per_s": rate, "n_requests": n,
+            "completed": kinds.get("result", 0),
+            "timeouts": kinds.get("timeout", 0),
+            "rejects": kinds.get("reject", 0),
+            "hangs": kinds.get("hang", 0),
+            "p50_ms": pct(lats, 50), "p99_ms": pct(lats, 99),
+            "throughput_per_s": round(kinds.get("result", 0) / elapsed,
+                                      1)})
+    recompiles = srv.steady_state_recompiles()
+    stats = srv.stats()
+    srv.close()
+    low = legs[0]
+    latency_ok = bool(low["p50_ms"]) and low["p99_ms"] is not None \
+        and low["p99_ms"] <= 10.0 * low["p50_ms"]
+    return {"bench": "serving_latency", "feature": feature,
+            "hidden": hidden, "buckets": list(cfg.buckets),
+            "deadline_ms": deadline_ms, "batch_wait_ms": batch_wait_ms,
+            "startup_compile_ms": round(startup_ms, 1),
+            "legs": legs,
+            "steady_state_recompiles": sum(recompiles.values()),
+            "recompile_ok": not recompiles,
+            "latency_ok": latency_ok,
+            "terminal_ok": hangs == 0,
+            "final_state": stats["state"],
+            "quarantined": stats["quarantined"]}
+
+
 def bench_ssd(batch_size=32, image_size=128, iters=8):
     """SSD detection train step ON-DEVICE (reference example/ssd +
     multibox_target.cu): forward + MultiBoxTarget assignment (pure
@@ -1052,6 +1155,36 @@ def smoke():
         "unit": "ms", "vs_baseline": None}))
 
 
+def serving_artifact(out_path):
+    """Cut the SERVE artifact: the serving-latency sweep (3 open-loop
+    arrival rates) + the run's telemetry snapshot, one JSON file.
+    Exits nonzero on any serving HARD failure (recompiles at steady
+    state, fat low-rate tail, non-terminal requests)."""
+    from mxnet_tpu import telemetry
+
+    result = bench_serving_latency()
+    tsnap = telemetry.snapshot(events=0)
+    details = [result,
+               {"bench": "telemetry_snapshot",
+                "spans": tsnap["spans"],
+                "counters": {k: v for k, v in tsnap["counters"].items()
+                             if k.startswith("serve.")},
+                "compiles": {k: v for k, v in tsnap["compiles"].items()
+                             if k.startswith("serve.")}}]
+    low = (result.get("legs") or [{}])[0]
+    out = {"metric": "serving_p99_ms_low_rate",
+           "value": low.get("p99_ms"), "unit": "ms",
+           "vs_baseline": None, "detail": details}
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "detail"}))
+    hard = _hard_failures(details)
+    for h in hard:
+        print("# HARD FAIL: %s" % h, file=sys.stderr)
+    if hard:
+        sys.exit(3)
+
+
 def main():
     # executable reuse across runs: the bench's wall time is dominated by
     # XLA compiles, which the persistent cache eliminates on repeats
@@ -1068,6 +1201,10 @@ def main():
     ap.add_argument("--input-pipeline-only", action="store_true",
                     help="run just the input-pipeline bench and print its "
                          "JSON (used by the isolated subprocess leg)")
+    ap.add_argument("--serving", action="store_true",
+                    help="run just the serving-latency bench and cut the "
+                         "SERVE artifact (default SERVE_r01.json)")
+    ap.add_argument("--serving-out", default="SERVE_r01.json")
     args = ap.parse_args()
 
     if args.smoke:
@@ -1075,6 +1212,9 @@ def main():
         return
     if args.input_pipeline_only:
         print(json.dumps(bench_input_pipeline()))
+        return
+    if args.serving:
+        serving_artifact(args.serving_out)
         return
 
     jobs = []
@@ -1112,6 +1252,10 @@ def main():
             iters=max(4, args.iters // 3)))
         jobs.append(lambda: bench_checkpoint_overhead(
             iters=max(4, args.iters // 3)))
+        # serving latency under open-loop load (3 arrival rates);
+        # recompiles-at-steady-state / fat-tail-at-low-rate / any
+        # non-terminal request are HARD failures
+        jobs.append(lambda: bench_serving_latency(duration_s=1.0))
         jobs.append(bench_input_pipeline_isolated)
     else:
         # the default run covers every BASELINE.json config (the driver
@@ -1316,6 +1460,24 @@ def _hard_failures(details):
                 and d["flash_speedup"] < 1.0:
             hard.append("attention S=512 flash_speedup %.2f < 1.0 "
                         "(kernel=%s)" % (d["flash_speedup"], d["kernel"]))
+        if d.get("bench") == "serving_latency":
+            if d.get("recompile_ok") is False:
+                hard.append(
+                    "serving steady-state recompiles: %s serve "
+                    "executables compiled during the load phase — the "
+                    "bucketed-AOT menu must compile at startup ONLY"
+                    % d.get("steady_state_recompiles"))
+            if d.get("latency_ok") is False:
+                low = (d.get("legs") or [{}])[0]
+                hard.append(
+                    "serving p99 %.3f ms > 10x p50 %.3f ms at the low "
+                    "rate (%s req/s) — fat tail on an unloaded server"
+                    % (low.get("p99_ms") or 0, low.get("p50_ms") or 0,
+                       low.get("rate_per_s")))
+            if d.get("terminal_ok") is False:
+                hard.append(
+                    "serving requests with NO terminal outcome — the "
+                    "no-hangs invariant failed under synthetic load")
         if d.get("bench") == "attention" and d.get("tuned_ok") is False:
             hard.append(
                 "attention %s tuned config (bq=%s, bk=%s, source=%s) "

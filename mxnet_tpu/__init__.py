@@ -67,6 +67,7 @@ from . import module
 from . import module as mod
 from . import callback
 from . import contrib
+from . import serve
 from . import monitor
 from . import visualization
 from . import visualization as viz

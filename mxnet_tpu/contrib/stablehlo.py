@@ -16,16 +16,25 @@ program IS the deployed program — no op-by-op conversion layer to drift.
 
     fn = mx.contrib.stablehlo.import_block("resnet")
     out = fn(batch)           # numpy/NDArray in, NDArray out
+
+Serving (``mxnet_tpu.serve``) rides the same path with a *bucketed*
+discipline (arxiv 2605.25645): :func:`export_bucketed` writes one
+artifact per batch bucket (``{prefix}-b{N}-stablehlo.bin``) so the
+server AOT-compiles a fixed shape menu at startup and recompiles
+nothing at steady state; :func:`load_bucketed` is its loader.
 """
 from __future__ import annotations
 
+import glob
+import re
 from typing import Optional, Sequence
 
 import numpy as onp
 
 from ..base import MXNetError
 
-__all__ = ["export_block", "import_block"]
+__all__ = ["export_block", "import_block", "export_bucketed",
+           "load_exported", "load_bucketed"]
 
 
 def _functional_eval_forward(net):
@@ -95,23 +104,106 @@ def export_block(prefix: str, net, input_shape: Sequence[int],
     return path
 
 
+def export_bucketed(prefix: str, net, buckets: Sequence[int],
+                    feature_shape: Sequence[int], dtype: str = "float32",
+                    epoch: int = 0,
+                    platforms: Optional[Sequence[str]] = None) -> list:
+    """Serialize one StableHLO artifact per batch bucket — the serving
+    export: ``{prefix}-b{N}-stablehlo.bin`` for each ``N`` in
+    ``buckets`` (batch dimension pinned per artifact, feature shape
+    shared), plus ONE ``{prefix}-{epoch:04d}.params`` file.  A serving
+    process loads the set with :func:`load_bucketed` (or
+    ``serve.InferenceServer.from_exported``) and AOT-compiles the whole
+    menu at startup, so steady-state traffic never compiles.  Returns
+    the artifact paths."""
+    import jax
+    from jax import export as jexport
+    from .. import ndarray as nd
+
+    fn, params = _functional_eval_forward(net)
+    if not params:
+        raise MXNetError("export_bucketed: net has no initialized "
+                         "parameters (call initialize() and run one "
+                         "forward first)")
+    pvals = [p._data._data for p in params]
+    p_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals]
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = list(platforms)
+    jfn = jax.jit(fn)
+    paths = []
+    for b in sorted(set(int(b) for b in buckets)):
+        if b < 1:
+            raise MXNetError("export_bucketed: bucket %d < 1" % b)
+        x_aval = jax.ShapeDtypeStruct((b,) + tuple(feature_shape),
+                                      onp.dtype(dtype))
+        exported = jexport.export(jfn, **kwargs)(p_avals, x_aval)
+        path = "%s-b%d-stablehlo.bin" % (prefix, b)
+        with open(path, "wb") as f:
+            f.write(exported.serialize())
+        paths.append(path)
+    nd.save("%s-%04d.params" % (prefix, epoch),
+            {("arg:%s" % p.name): p.data() for p in params})
+    return paths
+
+
+def _load_params(prefix: str, epoch: int) -> list:
+    """Param values in export order (sorted by parameter name)."""
+    from .. import ndarray as nd
+
+    loaded = nd.load("%s-%04d.params" % (prefix, epoch))
+    names = sorted(k[len("arg:"):] for k in loaded)
+    return [loaded["arg:" + n]._data for n in names]
+
+
+def load_exported(prefix: str, epoch: int = 0):
+    """(exported, pvals): the deserialized jax.export artifact plus the
+    parameter values in export order — the raw pieces ``import_block``
+    wraps and the serving stack AOT-compiles per bucket."""
+    from jax import export as jexport
+
+    with open("%s-stablehlo.bin" % prefix, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    return exported, _load_params(prefix, epoch)
+
+
+def load_bucketed(prefix: str, epoch: int = 0) -> dict:
+    """``{bucket: (exported, pvals)}`` for every
+    ``{prefix}-b*-stablehlo.bin`` artifact next to ``prefix`` (the
+    :func:`export_bucketed` layout).  The params file is read once and
+    shared."""
+    from jax import export as jexport
+
+    pat = re.compile(re.escape(prefix) + r"-b(\d+)-stablehlo\.bin$")
+    out = {}
+    pvals = None
+    # glob.escape: a prefix containing [, ? or * must match literally,
+    # like the regex side above
+    for path in sorted(glob.glob("%s-b*-stablehlo.bin"
+                                 % glob.escape(prefix))):
+        m = pat.match(path)
+        if m is None:
+            continue
+        if pvals is None:
+            pvals = _load_params(prefix, epoch)
+        with open(path, "rb") as f:
+            out[int(m.group(1))] = (jexport.deserialize(f.read()), pvals)
+    if not out:
+        raise MXNetError("load_bucketed: no %s-b*-stablehlo.bin "
+                         "artifacts found" % prefix)
+    return out
+
+
 def import_block(prefix: str, epoch: int = 0):
     """Load a StableHLO-exported model; returns ``fn(x) -> NDArray``.
 
     The artifact re-executes through jax.export's deserialized module —
     the identical compiled program the exporter traced."""
-    from jax import export as jexport
-    from .. import ndarray as nd
     from ..ndarray.ndarray import _wrap
 
     import jax
 
-    with open("%s-stablehlo.bin" % prefix, "rb") as f:
-        exported = jexport.deserialize(f.read())
-    loaded = nd.load("%s-%04d.params" % (prefix, epoch))
-    # parameter order matches export: sorted by parameter name
-    names = sorted(k[len("arg:"):] for k in loaded)
-    pvals = [loaded["arg:" + n]._data for n in names]
+    exported, pvals = load_exported(prefix, epoch)
     # compile once at load: exported.call outside jit re-traces per call
     run = jax.jit(lambda x: exported.call(pvals, x))
 

@@ -129,8 +129,14 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The full training loop (reference base_module.py:409)."""
+            monitor=None, sparse_row_id_fn=None, elastic=None):
+        """The full training loop (reference base_module.py:409).
+
+        ``elastic`` (opt-in) is a ``parallel.elastic.ElasticContext``:
+        every batch consults ``maybe_recover(step=nbatch)`` so a
+        mid-epoch world shrink (a preempted worker) re-forms the mesh,
+        re-shards the context's target and RESUMES the epoch in place —
+        no restart, no lost batches (docs/ROBUSTNESS.md)."""
         from .. import initializer as init_mod
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
@@ -161,6 +167,13 @@ class BaseModule:
             next_data_batch = next(data_iter)
             while not end_of_batch:
                 data_batch = next_data_batch
+                if elastic is not None:
+                    # detect -> re-form -> re-shard happens HERE, between
+                    # steps: the failed step's world is gone, this one's
+                    # runs on the survivor mesh (coordinator loss / joins
+                    # are reported and left to the caller's checkpoint
+                    # boundary)
+                    elastic.maybe_recover(step=nbatch)
                 if monitor is not None:
                     monitor.tic()
                 with telemetry.span("module.step") as _sp:

@@ -22,6 +22,23 @@ moment the real failure would land:
   between the tmp write and the ``os.replace`` commit: the crash window
   atomicity exists to survive.
 
+Serving faults (consulted by ``mxnet_tpu.serve.server`` — the chaos
+matrix in tests/test_serve_chaos.py drives all four):
+
+* ``request_burst``          — ``InferenceServer.submit`` amplifies one
+  real submission into ``factor`` admissions: a deterministic traffic
+  spike that must resolve through backpressure (queue-full rejects) and
+  priority shedding, never a blocked producer.
+* ``dispatch_stall``         — the dispatch worker sleeps ``delay``
+  seconds before running the executable (a hung device dispatch): the
+  watchdog must time the batch out and respawn the worker.
+* ``executable_poison``      — the dispatch raises instead of running
+  (optionally only for ``bucket=N``): bounded retry, then quarantine +
+  fallback onto smaller buckets.
+* ``deadline_storm``         — every submission's deadline collapses to
+  ``deadline_ms`` (default 0): the whole queue must expire through the
+  pre-dispatch drop path, wasting zero dispatches.
+
 Everything is counter-based — no randomness, no wall-clock triggers —
 so a chaos test that passes once passes every time.  All fault state
 lives behind one module lock: faults are installed from the main thread
@@ -33,8 +50,8 @@ import os
 import threading
 
 __all__ = ["ChaosError", "install", "clear", "active", "fired",
-           "should_fire", "maybe_kill", "garble", "wrap_kv_client",
-           "install_from_env", "ENV_VAR"]
+           "should_fire", "maybe_kill", "maybe_stall", "garble",
+           "wrap_kv_client", "install_from_env", "ENV_VAR"]
 
 ENV_VAR = "MXNET_TPU_CHAOS"
 
@@ -119,6 +136,19 @@ def maybe_kill(step=None, rank=None):
     like to the survivors)."""
     if should_fire("kill_worker", step=step, rank=rank):
         os._exit(int(active("kill_worker").get("exit_code") or 1))
+
+
+def maybe_stall(name, default_delay=0.25):
+    """Consultation point for stall-type faults (``dispatch_stall``,
+    and the same idiom ``kv_stall`` uses): when fault ``name`` fires,
+    sleep its ``delay`` parameter (a hung dispatch / stuck RPC as seen
+    by everything downstream).  Returns True when it stalled."""
+    if not should_fire(name):
+        return False
+    import time
+    spec = active(name) or {}
+    time.sleep(float(spec.get("delay") or default_delay))
+    return True
 
 
 def garble(payload):

@@ -1,0 +1,21 @@
+"""``mxnet_tpu.serve`` — fault-tolerant continuous-batching inference.
+
+The TPU serving stack (ROADMAP item 1): bucketed-shape AOT executables
+on the ``contrib.stablehlo`` export path (zero recompiles in steady
+state), a bounded request queue with dynamic batching, per-request
+deadlines, admission control with backpressure and priority shedding,
+a hung-dispatch watchdog with poisoned-executable quarantine, and a
+``STARTING -> READY -> DEGRADED -> DRAINING`` health state machine.
+See docs/SERVING.md.
+"""
+from .buckets import AotModel, pad_batch, pick_bucket, plan_buckets
+from .server import (DEGRADED, DRAINING, READY, STARTING,
+                     InferenceServer, PendingRequest, ServeConfig,
+                     ServeError, ServeRejected, ServeTimeout)
+
+__all__ = [
+    "AotModel", "pad_batch", "pick_bucket", "plan_buckets",
+    "InferenceServer", "PendingRequest", "ServeConfig",
+    "ServeError", "ServeRejected", "ServeTimeout",
+    "STARTING", "READY", "DEGRADED", "DRAINING",
+]

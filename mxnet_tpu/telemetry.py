@@ -56,7 +56,8 @@ from collections import deque
 __all__ = [
     "span", "observe", "inc", "counter", "gauge", "event", "snapshot",
     "reset", "enabled", "enable", "disable", "disabled",
-    "record_compile", "compile_counts", "sample_memory",
+    "record_compile", "compile_counts", "compile_deltas",
+    "sample_memory",
     "add_step_hook", "remove_step_hook", "emit_step",
     "export_chrome_trace", "export_jsonl", "set_jsonl_sink",
     "JOURNAL_MAXLEN",
@@ -314,6 +315,17 @@ def record_compile(fn, key):
 def compile_counts():
     with _lock:
         return {k: v["count"] for k, v in _compiles.items()}
+
+
+def compile_deltas(baseline):
+    """``{fn: extra compiles}`` for every function whose compile count
+    grew past a ``compile_counts()`` snapshot — the steady-state
+    zero-recompile gate's measurement (``serve.InferenceServer``
+    snapshots at start; ``bench.py serving_latency`` HARD-fails when
+    any ``serve.*`` entry appears here during the load phase)."""
+    cur = compile_counts()
+    return {k: v - baseline.get(k, 0) for k, v in cur.items()
+            if v > baseline.get(k, 0)}
 
 
 # ---------------------------------------------------------------------------

@@ -187,3 +187,23 @@ def test_history_keeps_bounded_entries(bench, tmp_path, monkeypatch):
     for i in range(15):
         bench._update_history([{"bench": "train", "img_per_sec": float(i)}])
     assert len(bench._load_history()) == 12
+
+
+def test_hard_failures_gate_serving_latency(bench):
+    """The serving hard gates: steady-state recompiles, a fat p99 tail
+    at the LOW rate, and any non-terminal request each fail the run;
+    a healthy serving artifact passes."""
+    good = {"bench": "serving_latency", "steady_state_recompiles": 0,
+            "recompile_ok": True, "latency_ok": True, "terminal_ok": True,
+            "legs": [{"rate_per_s": 25.0, "p50_ms": 4.0, "p99_ms": 8.0}]}
+    assert bench._hard_failures([good]) == []
+    recompiled = dict(good, steady_state_recompiles=2, recompile_ok=False)
+    hard = bench._hard_failures([recompiled])
+    assert len(hard) == 1 and "recompile" in hard[0]
+    fat = dict(good, latency_ok=False,
+               legs=[{"rate_per_s": 25.0, "p50_ms": 2.0, "p99_ms": 50.0}])
+    hard = bench._hard_failures([fat])
+    assert len(hard) == 1 and "p99" in hard[0]
+    hung = dict(good, terminal_ok=False)
+    hard = bench._hard_failures([hung])
+    assert len(hard) == 1 and "terminal" in hard[0]

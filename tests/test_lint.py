@@ -372,6 +372,27 @@ def test_changed_closure_covers_conc_rules(tmp_path):
         sorted(rules)
 
 
+def test_changed_closure_covers_serve_stop_path(tmp_path):
+    """CI/tooling satellite: a change to the serving bucket policy must
+    pull the server module — the stop/drain path the conc-* rules gate
+    — into the --changed reverse-dependency closure (server.py imports
+    buckets.py), so an edit under serve/ can never dodge the
+    thread-lifecycle analysis.  Scoped to the serve package: the
+    closure property under test is intra-package (server.py imports
+    buckets.py) and the full-package changed-run budget is already
+    owned by test_changed_mode_matches_full_run."""
+    target = "mxnet_tpu/serve/buckets.py"
+    result = run_lint([os.path.join(REPO, "mxnet_tpu", "serve")],
+                      baseline_path=None, changed_files=[target])
+    assert target in result.files
+    assert "mxnet_tpu/serve/server.py" in result.files
+    assert "mxnet_tpu/serve/__init__.py" in result.files
+    # and the closure run stays clean over serve/ like the full gate
+    bad = [f for f in result.new
+           if f.path.startswith("mxnet_tpu/serve/")]
+    assert not bad, "\n".join(f.render() for f in bad)
+
+
 def test_list_rules_groups_by_family():
     env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
                + os.environ.get("PYTHONPATH", ""))

@@ -216,3 +216,52 @@ def test_sequential_module_trains():
     # composite params gather from both children
     arg, _ = seq.get_params()
     assert "m1fc_weight" in arg and "m2fc_weight" in arg
+
+
+def test_module_fit_elastic_midfit_shrink_resumes_epoch():
+    """ROADMAP item-4 follow-up: Module.fit(elastic=ctx) consults the
+    ElasticContext every batch — a mid-fit world shrink (liveness
+    reports a departed worker at batch 3) re-forms the mesh, re-shards
+    the context's target, and the SAME epoch resumes in place: fit
+    finishes every epoch and still converges."""
+    import jax
+    from mxnet_tpu.parallel import get_mesh, set_mesh
+    from mxnet_tpu.parallel.elastic import ElasticContext
+
+    calls = {"probe": 0, "resharded": []}
+
+    def liveness():
+        calls["probe"] += 1
+        # healthy for the first 3 batch probes, then one dead worker
+        return 0 if calls["probe"] <= 3 else 1
+
+    class StubTarget:
+        _mesh = None
+
+        def reshard(self, mesh):
+            calls["resharded"].append(int(mesh.size))
+            return 0
+
+    X, Y = _toy_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True,
+                              label_name="softmax_label")
+    ctx = ElasticContext(target=StubTarget(), liveness=liveness,
+                         world_size=4)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    prev_mesh = get_mesh()
+    try:
+        mod.fit(train, num_epoch=12, kvstore="local",
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5,
+                                  "rescale_grad": 1.0 / 32},
+                initializer=mx.init.Xavier(),
+                elastic=ctx)
+    finally:
+        set_mesh(prev_mesh)
+    # the shrink happened mid-epoch (batch 4 of 8) and training went on
+    assert calls["resharded"] == [len(jax.local_devices())]
+    assert ctx.world == 3
+    # every batch of every epoch was consulted — the epoch resumed
+    assert calls["probe"] == 12 * 8
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.90, score

@@ -1,0 +1,245 @@
+"""Serving chaos matrix: every injected fault, every request terminal.
+
+The serving stack's robustness contract under the deterministic fault
+harness (``mxnet_tpu.parallel.chaos``): for each serve fault mode —
+``request_burst``, ``dispatch_stall``, ``executable_poison``,
+``deadline_storm`` — every submitted request (synthetic burst clones
+included) reaches a terminal outcome (result / timeout / reject) within
+its deadline + grace, the server never deadlocks, and the shutdown is
+clean.  Every scenario runs inside ``LockOrderSanitizer`` and must
+satisfy the PR-7 static-vs-runtime contract: the observed
+acquisition-order graph is cycle-free AND a subgraph of
+``tools.lint.concurrency.static_lock_graph(mxnet_tpu/)``.
+
+The graftlint side of the same coin: the serve threads are registered
+in the package thread-entry model (conc-thread-lifecycle sees the stop
+Event + joins), and the package gate keeps ZERO findings / an empty
+baseline over mxnet_tpu/serve/.
+"""
+import collections
+import os
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serve, telemetry
+from mxnet_tpu.parallel import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint.runtime_lockorder import LockOrderSanitizer  # noqa: E402
+
+# package_lock_graph: session-scoped fixture from tests/conftest.py
+
+FEAT = (8,)
+W = onp.ones((8, 3), "float32")
+
+
+def _fn(x):
+    import jax.numpy as jnp
+    return x @ jnp.asarray(W)
+
+
+def _cfg(**kw):
+    base = dict(buckets=(1, 2, 4), max_queue=8, batch_wait_ms=2.0,
+                default_deadline_ms=400.0, dispatch_timeout_ms=80.0,
+                watchdog_interval_ms=15.0)
+    base.update(kw)
+    return serve.ServeConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+GRACE_S = 1.5
+
+
+def _drive(install_fault, package_lock_graph, n=8, deadline_ms=400.0,
+           cfg=None, second_wave=0, wave2_delay=0.0):
+    """One chaos scenario under the sanitizer.  Returns (terminal
+    outcome counter over ALL requests incl. synthetic clones, the
+    server, second-wave outcomes)."""
+    with LockOrderSanitizer() as san:
+        srv = serve.InferenceServer(_fn, feature_shape=FEAT,
+                                    config=cfg or _cfg())
+        srv.start()
+        install_fault(srv)
+        handles = [srv.submit(onp.full(FEAT, i, "float32"),
+                              deadline_ms=deadline_ms)
+                   for i in range(n)]
+        wait = deadline_ms / 1e3 + GRACE_S
+        outs = [h.outcome(timeout=wait) for h in handles]
+        outs += [c.outcome(timeout=wait) for c in srv._synthetic]
+        wave2 = []
+        if second_wave:
+            chaos.clear()
+            if wave2_delay:
+                time.sleep(wave2_delay)
+            for i in range(second_wave):
+                h = srv.submit(onp.full(FEAT, i, "float32"),
+                               deadline_ms=deadline_ms)
+                wave2.append(h.outcome(timeout=wait))
+        assert srv.close(timeout=10.0)
+    # the no-hangs invariant: EVERY request reached a terminal outcome
+    # within deadline + grace
+    assert all(o is not None for o in outs), \
+        "requests with no terminal outcome under chaos"
+    for t in (srv._batcher, srv._watchdog, srv._dispatcher):
+        assert t is not None and not t.is_alive()
+    san.assert_no_cycles()
+    san.assert_subgraph_of(package_lock_graph)
+    return (collections.Counter(o[0] for o in outs), srv,
+            collections.Counter(o[0] for o in wave2 if o is not None))
+
+
+def test_request_burst_backpressure_not_blocking(package_lock_graph):
+    """A deterministic traffic spike: ONE real submission fans into 32
+    admissions.  The bounded queue must shed the overflow as immediate
+    rejects (never a blocked producer), serve what it admitted, and
+    leave every clone terminal."""
+    kinds, srv, _ = _drive(
+        lambda s: chaos.install("request_burst", factor=32, times=1),
+        package_lock_graph, n=2, deadline_ms=600.0)
+    assert kinds["result"] >= 1
+    assert kinds["reject"] >= 1          # queue_full backpressure fired
+    assert sum(kinds.values()) == 2 + 31
+    assert telemetry.counter("serve.rejects") > 0
+
+
+def test_dispatch_stall_watchdog_respawns(package_lock_graph):
+    """A hung dispatch (0.4 s stall vs an 80 ms dispatch timeout): the
+    watchdog times the stuck batch out, respawns a dispatcher, and a
+    second wave — submitted after the fault cleared — is served by the
+    replacement."""
+    fires0 = telemetry.counter("serve.watchdog_fires")
+    kinds, srv, wave2 = _drive(
+        lambda s: chaos.install("dispatch_stall", times=1, delay=0.4),
+        package_lock_graph, n=6, deadline_ms=400.0, second_wave=3)
+    assert kinds["timeout"] >= 1         # the stalled batch
+    assert telemetry.counter("serve.watchdog_fires") > fires0
+    assert srv.stats()["respawns"] >= 1
+    assert wave2["result"] == 3          # the respawned dispatcher serves
+
+
+def test_executable_poison_quarantine_and_fallback(package_lock_graph):
+    """The b=4 executable is poisoned (fails every dispatch): after the
+    bounded retry it is quarantined and the SAME requests complete on
+    smaller buckets — graceful degradation, zero client-visible
+    failures."""
+    q0 = telemetry.counter("serve.quarantines")
+    kinds, srv, _ = _drive(
+        lambda s: chaos.install("executable_poison", bucket=4),
+        package_lock_graph, n=8, deadline_ms=800.0)
+    assert kinds["result"] == 8, kinds
+    assert telemetry.counter("serve.quarantines") == q0 + 1
+    assert srv.stats()["quarantined"] == [4]
+    # operator runbook: reset re-admits the bucket
+    assert srv.reset_quarantine() == [4]
+    assert srv.stats()["quarantined"] == []
+
+
+def test_poison_all_buckets_is_terminal_error(package_lock_graph):
+    """Every bucket poisoned: requests must still terminate — as errors
+    — and the server must degrade, not deadlock."""
+    kinds, srv, _ = _drive(
+        lambda s: chaos.install("executable_poison"),
+        package_lock_graph, n=4, deadline_ms=600.0,
+        cfg=_cfg(max_retries=0))
+    assert kinds["result"] == 0
+    assert kinds["error"] + kinds["timeout"] == 4, kinds
+    assert set(srv.stats()["quarantined"]) <= {1, 2, 4}
+
+
+def test_deadline_storm_expires_without_dispatch(package_lock_graph):
+    """Every deadline collapses to 0: the whole queue must expire
+    through the pre-dispatch drop path — terminal timeouts, zero
+    executable dispatches wasted."""
+    d0 = telemetry.counter("serve.dispatches")
+    drops0 = telemetry.counter("serve.deadline_drops")
+    kinds, srv, _ = _drive(
+        lambda s: chaos.install("deadline_storm", deadline_ms=0),
+        package_lock_graph, n=8)
+    assert kinds["timeout"] == 8, kinds
+    assert telemetry.counter("serve.dispatches") == d0
+    assert telemetry.counter("serve.deadline_drops") >= drops0 + 8
+
+
+def test_respawn_budget_exhausted_still_terminal(package_lock_graph):
+    """Review hardening: with the respawn budget at ZERO and the only
+    dispatcher wedged, batches piling into the dispatch queue must
+    still reach terminal outcomes — the watchdog becomes the consumer
+    of record (fail-fast terminal errors in the permanent-DEGRADED
+    tail), never a hang."""
+    kinds, srv, wave2 = _drive(
+        lambda s: chaos.install("dispatch_stall", times=1, delay=0.4),
+        package_lock_graph, n=6, deadline_ms=300.0,
+        cfg=_cfg(max_respawns=0, dispatch_timeout_ms=60.0,
+                 batch_wait_ms=1.0, buckets=(1, 2)),
+        second_wave=3, wave2_delay=0.6)
+    # every first-wave request terminal (stuck batch -> watchdog
+    # timeout; queued batches -> watchdog drain errors) — NO hangs
+    assert sum(kinds.values()) == 6
+    assert kinds["timeout"] >= 1 and kinds["result"] == 0, kinds
+    assert srv.stats()["respawns"] == 0
+    # past the budget the server fails FAST and stays DEGRADED even
+    # after the wedged worker's stall ends — restart territory
+    assert wave2["error"] == 3, wave2
+
+
+def test_config_rejects_unbounded_queue():
+    with pytest.raises(mx.MXNetError):
+        serve.ServeConfig(max_queue=0)
+    with pytest.raises(mx.MXNetError):
+        serve.ServeConfig(max_queue=-4)
+
+
+# -- graftlint registration -------------------------------------------------
+
+def test_serve_threads_in_lint_thread_entry_model():
+    """CI/tooling satellite: the serve batcher/watchdog/dispatcher
+    Thread(target=self._method) sites must resolve in the graftlint
+    thread-entry model — that is what puts the serve stop/drain path
+    under conc-thread-lifecycle (stop Event + join) and the other
+    conc-* rules."""
+    from tools.lint.core import ModuleInfo, collect_files
+    from tools.lint.jitgraph import PackageIndex
+    serve_dir = os.path.join(REPO, "mxnet_tpu", "serve")
+    mods = []
+    for p in collect_files([serve_dir]):
+        rel = os.path.relpath(p, REPO).replace(os.sep, "/")
+        mods.append(ModuleInfo(p, rel, open(p).read()))
+    idx = PackageIndex(mods)
+    entries = sorted(idx.thread_entries().values())
+    server_rel = "mxnet_tpu/serve/server.py"
+    assert sum(1 for e in entries if e.startswith(server_rel)) >= 3, \
+        entries                      # batcher + watchdog + dispatcher
+    # the loops those threads run are thread-context for the rules
+    names = {fi.name for fi in idx.functions
+             if id(fi.node) in idx.thread_reachable()}
+    assert {"_batch_loop", "_watchdog_loop",
+            "_dispatch_loop"} <= names, names
+
+
+def test_serve_package_gate_zero_findings(package_scan):
+    """The tier-1 gate satellite, made explicit for the new subsystem:
+    mxnet_tpu/serve/ is scanned and contributes ZERO findings (and zero
+    suppressions — the baseline stays empty)."""
+    serve_files = [f for f in package_scan.files
+                   if f.startswith("mxnet_tpu/serve/")]
+    assert len(serve_files) >= 3, package_scan.files
+    bad = [f for f in package_scan.new
+           if f.path.startswith("mxnet_tpu/serve/")]
+    assert not bad, "\n".join(f.render() for f in bad)
+    suppressed = [f for f in package_scan.suppressed
+                  if f.path.startswith("mxnet_tpu/serve/")]
+    assert not suppressed, \
+        "serve/ should need no suppressions: %r" % suppressed

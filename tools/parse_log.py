@@ -86,6 +86,8 @@ def parse_jsonl(lines):
     numerics = {}
     autotune = []
     elastic = []
+    serve = {"events": {}, "batches": 0, "fill_pct_sum": 0.0,
+             "queue_depth_sum": 0, "wait_ms_sum": 0.0, "states": []}
     lint_gate = None
     steps = 0
     for line in lines:
@@ -165,6 +167,22 @@ def parse_jsonl(lines):
                             "dur_ms": rec.get("dur_ms"),
                             "detail": rec.get("change") or rec.get("reason")
                             or rec.get("error")})
+        elif kind == "serve":
+            # serving-stack journal events (mxnet_tpu.serve.server):
+            # per-batch fill/queue-depth stream plus one row per
+            # shed/timeout/reject/watchdog/quarantine/state transition
+            name = rec.get("name", "?")
+            serve["events"][name] = serve["events"].get(name, 0) + 1
+            if name == "batch":
+                serve["batches"] += 1
+                serve["fill_pct_sum"] += float(rec.get("fill_pct") or 0.0)
+                serve["queue_depth_sum"] += int(
+                    rec.get("queue_depth") or 0)
+                serve["wait_ms_sum"] += float(rec.get("wait_ms") or 0.0)
+            elif name == "state":
+                serve["states"].append(
+                    "%s->%s" % (rec.get("state_from"),
+                                rec.get("state_to")))
         elif kind == "lint" and rec.get("name") == "gate":
             lint_gate = rec
         elif kind == "snapshot":
@@ -180,7 +198,7 @@ def parse_jsonl(lines):
     return {"spans": spans, "counters": counters, "gauges": gauges,
             "recompiles": recompiles, "steps": steps, "hbm": hbm,
             "lockorder": lockorder, "numerics": numerics,
-            "autotune": autotune, "elastic": elastic,
+            "autotune": autotune, "elastic": elastic, "serve": serve,
             "lint_gate": lint_gate}
 
 
@@ -247,8 +265,45 @@ def render_jsonl(agg, fmt="markdown"):
     out.extend(_render_autotune(agg.get("autotune") or [],
                                 agg.get("counters") or {}, fmt))
     out.extend(_render_elastic(agg.get("elastic") or [], fmt))
+    out.extend(_render_serve(agg.get("serve") or {},
+                             agg.get("counters") or {}, fmt))
     out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
+
+
+def _render_serve(serve, counters, fmt="markdown"):
+    """Serving journal census: dispatched-batch aggregates (count, mean
+    fill %, mean queue depth, mean batch wait) plus one row per event
+    kind (sheds, timeouts, rejects, watchdog fires, quarantines, state
+    transitions) — the client-visible failure envelope at a glance."""
+    events = (serve or {}).get("events") or {}
+    if not events and not any(k.startswith("serve.") for k in counters):
+        return []
+    out = ["", "serve journal census:"]
+    n = serve.get("batches", 0)
+    if n:
+        out.append(
+            "  batches=%d mean-fill=%.1f%% mean-queue-depth=%.2f "
+            "mean-wait-ms=%.3f"
+            % (n, serve["fill_pct_sum"] / n,
+               serve["queue_depth_sum"] / n, serve["wait_ms_sum"] / n))
+    header = ["event", "count"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    for name in sorted(events):
+        vals = ["serve/%s" % name, str(events[name])]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    counts = " ".join("%s=%s" % (k.split(".", 1)[1], counters[k])
+                      for k in sorted(counters)
+                      if k.startswith("serve."))
+    if counts:
+        out.append("  counters: %s" % counts)
+    if serve.get("states"):
+        out.append("  state transitions: %s"
+                   % " ".join(serve["states"]))
+    return out
 
 
 def _render_elastic(elastic, fmt="markdown"):
