@@ -67,6 +67,11 @@ class _FusedUpdate:
         # does this), ``invalidate_sharded()`` drops the mirror after an
         # external state load.
         self._shard_opt = bool(shard_optimizer)
+        # "auto" defers the final call to _shard_ready: measured via the
+        # prog_zero cost-table entry when one exists, else today's
+        # shard-when-possible heuristic
+        self._shard_knob = shard_optimizer
+        self._auto_decided = False
         self._sharded = {}       # index -> flat dp-sharded state leaves
         self._shard_mesh = None
         self._shard_n = 0
@@ -106,6 +111,34 @@ class _FusedUpdate:
         if mesh is None or "dp" not in mesh.axis_names or \
                 mesh.shape["dp"] <= 1:
             return False
+        if self._shard_knob == "auto" and not self._auto_decided:
+            # decided once per trainer (first eligible step), journaled
+            # with the path taken — mirrors DataParallelStep's
+            # _auto_shard_decision
+            self._auto_decided = True
+            shard, path, src = True, "heuristic", "heuristic"
+            try:
+                pcount = sum(int(onp.prod(w.shape)) for w in weights)
+            except Exception:
+                pcount = 0
+            if pcount > 0:
+                try:
+                    from ..tune import program as _prog
+                    cfg = _prog.program_config(
+                        "prog_zero",
+                        (_prog.canon_param_count(pcount),
+                         int(mesh.shape["dp"])))
+                except Exception:
+                    cfg = None
+                if cfg is not None:
+                    shard = bool(cfg["shard"])
+                    path, src = "measured", cfg.get("source", "table")
+            telemetry.event("zero", "trainer_auto_decision", path=path,
+                            shard=bool(shard), params=int(pcount),
+                            dp=int(mesh.shape["dp"]), tuner_source=src)
+            if not shard:
+                self._shard_opt = False
+                return False
         repl = jsh.NamedSharding(mesh, jsh.PartitionSpec())
         for w in weights:
             sh = getattr(w._data, "sharding", None)

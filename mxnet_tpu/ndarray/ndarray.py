@@ -675,8 +675,6 @@ def split(data, num_outputs: int, axis: int = 1, squeeze_axis: bool = False):
             parts = [jnp.squeeze(p, axis=axis) for p in parts]
         return tuple(parts)
     out = invoke_fn(fn, [data], name="split")
-    # graftlint: disable-next=trace-tracer-branch -- num_outputs is the
-    # op's Python int attribute, fixed at call construction
     return out[0] if num_outputs == 1 else out
 
 

@@ -81,8 +81,6 @@ def reduce_scatter(x, axis_name: str = "dp", scatter_dimension: int = 0):
 def padded_size(n: int, axis_size: int) -> int:
     """Smallest multiple of ``axis_size`` >= n (and >= axis_size, so a
     scalar leaf still gives every replica one element)."""
-    # graftlint: disable-next=trace-host-sync -- n/axis_size are Python
-    # shape arithmetic (array dims and mesh axis sizes), never tracers
     return max(1, -(-int(n) // int(axis_size))) * int(axis_size)
 
 
@@ -98,8 +96,6 @@ def flatten_pad(x, axis_size: int):
     val = _unwrap(x)
     flat = val.reshape(-1)
     pad = padded_size(flat.shape[0], axis_size) - flat.shape[0]
-    # graftlint: disable-next=trace-tracer-branch -- pad is static shape
-    # arithmetic (tracer .shape is a Python tuple), a trace-time constant
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     return flat
@@ -110,8 +106,6 @@ def unflatten(flat, shape):
     val = _unwrap(flat)
     n = 1
     for d in shape:
-        # graftlint: disable-next=trace-host-sync -- shape is a Python
-        # tuple of static dims, never traced
         n *= int(d)
     return val[:n].reshape(shape)
 

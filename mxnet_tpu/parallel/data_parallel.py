@@ -235,9 +235,44 @@ class DataParallelStep:
                           "axis; falling back to the replicated update")
             return 0
         n = mesh.shape["dp"]
-        if knob == "auto" and n <= 1:
-            return 0     # nothing to shard over; keep the proven path
+        if knob == "auto":
+            if n <= 1:
+                return 0     # nothing to shard over; keep the proven path
+            return int(n) if self._auto_shard_decision(int(n)) else 0
         return int(n)
+
+    def _auto_shard_decision(self, n):
+        """``"auto"`` with a dp>1 mesh: MEASURED when the program cost
+        table holds a ``prog_zero`` entry for this (canonical param
+        count, dp extent) — the offline ``python -m mxnet_tpu.tune
+        --program`` search or a bench writes one — else today's
+        heuristic (shard).  Which path decided, and what it decided, is
+        journaled as a ``zero``/``auto_decision`` event so the census
+        can tell a measured schedule from a guessed one."""
+        from .. import telemetry
+        shard, path, src = True, "heuristic", "heuristic"
+        pcount = 0
+        try:
+            pcount = sum(
+                int(onp.prod(p._data.shape))
+                for _, p in self._net.collect_params().items()
+                if p._data is not None and p.grad_req != "null")
+        except Exception:
+            pcount = 0
+        if pcount > 0:
+            try:
+                from ..tune import program as _prog
+                cfg = _prog.program_config(
+                    "prog_zero", (_prog.canon_param_count(pcount), n))
+            except Exception:
+                cfg = None
+            if cfg is not None:
+                shard = bool(cfg["shard"])
+                path, src = "measured", cfg.get("source", "table")
+        telemetry.event("zero", "auto_decision", path=path,
+                        shard=bool(shard), params=int(pcount), dp=int(n),
+                        tuner_source=src)
+        return shard
 
     def _shard_sharding(self, replicated=False):
         import jax.sharding as jsh

@@ -40,7 +40,8 @@ import numpy as onp
 from .. import telemetry
 from ..base import MXNetError
 
-__all__ = ["pick_bucket", "plan_buckets", "pad_batch", "AotModel"]
+__all__ = ["pick_bucket", "plan_buckets", "pad_batch", "AotModel",
+           "default_bucket_menu"]
 
 # per-process de-dup of model display names: two AotModel instances
 # sharing a name would share recompile-detector keys, so the second
@@ -52,6 +53,36 @@ def _unique_name(name):
     seq = _NAME_SEQ.get(name, 0) + 1
     _NAME_SEQ[name] = seq
     return name if seq == 1 else "%s#%d" % (name, seq)
+
+
+def default_bucket_menu(max_batch: int = 8, feature_shape=(),
+                        dtype="float32", budget=None):
+    """``(menu, tuner_source)`` for a served max batch of ``max_batch``:
+    the measured ``prog_buckets`` schedule when the program cost table
+    holds one (``python -m mxnet_tpu.tune --program`` writes it), else
+    the geometric heuristic (powers of two up to ``max_batch`` — the
+    historical ``(1, 2, 4, 8)`` default, so an untuned process serves
+    the same menu it always did).  Either way the menu is pre-validated
+    against the static HBM estimator (``tune.program.validate_menu``
+    over ``tools.lint.hbm`` arithmetic) BEFORE any executable is
+    compiled — an over-budget menu sheds its largest buckets here, not
+    at compile time."""
+    from ..tune import program as _prog
+
+    mb = 1 << max(0, (int(max_batch) - 1).bit_length())
+    heur = _prog.menu_from_config(
+        _prog.heuristic_config("prog_buckets", (mb,)))
+    source = "heuristic"
+    try:
+        cfg = _prog.program_config("prog_buckets", (mb,))
+    except Exception:
+        cfg = None
+    menu = heur
+    if cfg is not None:
+        menu = _prog.menu_from_config(cfg)
+        source = cfg.get("source", "table")
+    menu = _prog.validate_menu(menu, feature_shape, dtype, budget=budget)
+    return (menu or heur[:1]), source
 
 
 def pick_bucket(n: int, buckets: Sequence[int],

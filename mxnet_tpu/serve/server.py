@@ -82,9 +82,17 @@ class ServeConfig:
                  watchdog_interval_ms=25.0, max_retries=1,
                  shed_fraction=0.75, resume_fraction=0.25,
                  max_respawns=4, poll_ms=20.0):
-        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
-        if not self.buckets or self.buckets[0] < 1:
-            raise MXNetError("ServeConfig: buckets must be >= 1")
+        if isinstance(buckets, str):
+            if buckets != "auto":
+                raise MXNetError("ServeConfig: buckets must be ints or "
+                                 "'auto', got %r" % (buckets,))
+            # resolved at InferenceServer construction, where the
+            # model's feature shape (the HBM-validation input) is known
+            self.buckets = "auto"
+        else:
+            self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if not self.buckets or self.buckets[0] < 1:
+                raise MXNetError("ServeConfig: buckets must be >= 1")
         self.max_queue = int(max_queue)
         if self.max_queue < 1:
             # queue.Queue(maxsize=0) means UNBOUNDED — the exact thing
@@ -203,6 +211,19 @@ class InferenceServer:
                 model, feature_shape=feature_shape, dtype=dtype,
                 name=name)
         self.name = self._model.name
+        self.bucket_source = "explicit"
+        if self._cfg.buckets == "auto":
+            # measured menu when the program cost table has one, the
+            # historical geometric default otherwise — HBM-validated
+            # either way (buckets.default_bucket_menu)
+            from .buckets import default_bucket_menu
+            menu, self.bucket_source = default_bucket_menu(
+                feature_shape=self._model.feature_shape,
+                dtype=self._model.dtype)
+            self._cfg.buckets = tuple(menu)
+            telemetry.event("serve", "bucket_menu", model=self.name,
+                            buckets=list(self._cfg.buckets),
+                            tuner_source=self.bucket_source)
         self._lock = threading.Lock()
         self._q = queue.Queue(maxsize=self._cfg.max_queue)
         self._dq = queue.Queue(maxsize=2)

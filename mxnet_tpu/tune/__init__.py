@@ -35,13 +35,15 @@ import os
 from typing import Optional, Sequence, Tuple
 
 from . import cost_table, search
-from .cost_table import (CostTable, FAMILY_FIELDS, SCHEMA_VERSION,
-                         canon_dtype, canon_shape, default_table_path,
+from .cost_table import (CostTable, FAMILY_FIELDS, KERNEL_FAMILIES,
+                         SCHEMA_VERSION, canon_dtype, canon_shape,
+                         baked_table_path, default_table_path,
                          platform_id)
 
-__all__ = ["CostTable", "table_config", "table_blocks", "table_path",
-           "autotune_enabled", "get_table", "default_table_path",
-           "platform_id", "search", "cost_table"]
+__all__ = ["CostTable", "table_config", "table_blocks", "model_blocks",
+           "program_knobs", "table_path", "autotune_enabled",
+           "get_table", "default_table_path", "baked_table_path",
+           "platform_id", "search", "cost_table", "model", "program"]
 
 _TABLE = {"instance": None}
 # instances whose on-miss search already failed this process: retraces
@@ -51,9 +53,12 @@ _FAILED_SEARCHES = set()
 
 
 def get_table() -> CostTable:
-    """Process-level table singleton (path fixed at first use)."""
+    """Process-level table singleton (path fixed at first use), layered
+    over the shipped read-only baked table when one exists for this
+    platform (see :func:`cost_table.baked_table_path`)."""
     if _TABLE["instance"] is None:
-        _TABLE["instance"] = CostTable(default_table_path())
+        _TABLE["instance"] = CostTable(default_table_path(),
+                                       baked=baked_table_path())
     return _TABLE["instance"]
 
 
@@ -131,14 +136,17 @@ def table_config(family: str, shape: Sequence[int], dtype,
         telemetry.event("autotune", "fallback", family=family,
                         shape=list(shape), dtype=dt, config=cfg,
                         reason="invalid_table_config")
-    if _search_allowed() and (family, shape, dt) not in _FAILED_SEARCHES:
+    if family in KERNEL_FAMILIES and _search_allowed() \
+            and (family, shape, dt) not in _FAILED_SEARCHES:
         res = _dispatch_search(family, shape, dt)
         if res is not None:
             telemetry.inc("autotune.search")
             telemetry.event("autotune", "search", family=family,
                             shape=list(shape), dtype=dt,
                             config=res["config"],
-                            ms=res["best_ms"], trials=res["trials"])
+                            ms=res["best_ms"], trials=res["trials"],
+                            interpret=res.get("interpret", False),
+                            ranked=res.get("ranked", False))
             return dict(res["config"], source="searched")
         _FAILED_SEARCHES.add((family, shape, dt))
         if rec is None:
@@ -161,21 +169,41 @@ def table_config(family: str, shape: Sequence[int], dtype,
 
 def _dispatch_search(family, shape, dt):
     """On-miss search at dispatch time: strict budget, result persisted
-    (best-effort — an unwritable table still returns the config)."""
+    (best-effort — an unwritable table still returns the config).
+
+    v2: the search is model-ranked when the learned cost model is
+    usable — same budget knob, but only the top-K predicted candidates
+    get timed.  An untrained/over-CV model counts one
+    ``autotune.model_fallback`` and the search degrades to v1's
+    log-distance order, bit-identically."""
+    from .. import telemetry
+    from . import model as _model
     interp = os.environ.get("MXNET_AUTOTUNE_INTERPRET", "0") == "1" \
         and not _platform_is_tpu()
+    cm = None
+    if _model.model_enabled():
+        try:
+            cm = _model.get_model(family)
+        except Exception:
+            cm = None
+        if cm is None:
+            telemetry.inc("autotune.model_fallback")
+            telemetry.event("autotune", "model_fallback", family=family,
+                            shape=list(shape), dtype=dt,
+                            reason="untrained_or_cv")
     res = search.search_config(
         family, shape, dt,
         trials=_budget("MXNET_AUTOTUNE_TRIALS", search.DEFAULT_TRIALS),
         calls=_budget("MXNET_AUTOTUNE_CALLS", search.DEFAULT_CALLS),
-        interpret=interp)
+        interpret=interp, model=cm)
     if res is None:
         return None
     try:
         get_table().record(family, shape, dt, res["config"],
                            best_ms=res["best_ms"], source="searched",
                            trials=res["trials"],
-                           interpret=res.get("interpret", False))
+                           interpret=res.get("interpret", False),
+                           results=res.get("results"))
     except OSError:
         pass
     return res
@@ -202,9 +230,76 @@ def table_blocks(family: str, shape: Sequence[int], dtype,
     return out if len(out) > 1 else out[0]
 
 
+def model_config(family: str, shape: Sequence[int], dtype,
+                 quiet: bool = False) -> Optional[dict]:
+    """:func:`table_config` plus the learned-model fallback: on a true
+    miss where on-miss search is not possible (off-TPU without the
+    interpret opt-in, or a search that failed) but ``MXNET_AUTOTUNE``
+    is on and the cost model is usable, serve the predicted-fastest
+    VALID candidate with ``source="model"`` (counter
+    ``autotune.model_hit``).  The model leg stays behind the SAME env
+    gate as search — default mode still resolves heuristic,
+    bit-identically — and only ever picks from the statically-pruned
+    candidate grid, so it cannot emit a config the VMEM predicate (or
+    graftlint) would reject."""
+    cfg = table_config(family, shape, dtype, quiet=quiet)
+    if cfg is not None:
+        return cfg
+    if family not in KERNEL_FAMILIES or not autotune_enabled():
+        return None
+    from . import model as _model
+    try:
+        cm = _model.get_model(family)
+    except Exception:
+        cm = None
+    if cm is None:
+        return None
+    shape = canon_shape(shape)
+    dt = canon_dtype(dtype, family)
+    try:
+        cands = search.candidates(family, shape, dt)
+        best = min(cands, key=lambda c: (cm.predict_config_ms(shape, dt,
+                                                              c),
+                                         tuple(sorted(c.items()))))
+    except Exception:
+        return None
+    if not quiet:
+        from .. import telemetry
+        telemetry.inc("autotune.model_hit")
+        telemetry.event("autotune", "model_pick", family=family,
+                        shape=list(shape), dtype=dt, config=best,
+                        cv_error=cm.cv_error, n_samples=cm.n_samples)
+    return dict(best, source="model")
+
+
+def model_blocks(family: str, shape: Sequence[int], dtype,
+                 default: Optional[Tuple[int, ...]] = None,
+                 quiet: bool = False):
+    """:func:`table_blocks` with the learned-model fallback of
+    :func:`model_config` — same tuple contract, same ``default=``
+    literal that graftlint's static pallas estimator resolves (the
+    checker folds ``model_blocks`` exactly like ``table_blocks``)."""
+    cfg = model_config(family, shape, dtype, quiet=quiet)
+    if cfg is None:
+        return default
+    out = tuple(cfg[f] for f in FAMILY_FIELDS[family])
+    return out if len(out) > 1 else out[0]
+
+
+def program_knobs(family, shape, default=None, quiet=False):
+    """Tuned program-level schedule knobs (see :mod:`tune.program`) —
+    re-exported here so consumers and graftlint resolve one spelling."""
+    from . import program
+    return program.program_knobs(family, shape, default=default,
+                                 quiet=quiet)
+
+
 def _reset_for_tests():
-    """Forget the table singleton, failed-search memo and platform id
-    (tests repoint MXNET_AUTOTUNE_TABLE between cases)."""
+    """Forget the table singleton, failed-search memo, trained models
+    and platform id (tests repoint MXNET_AUTOTUNE_TABLE between
+    cases)."""
+    from . import model as _model
     _TABLE["instance"] = None
     _FAILED_SEARCHES.clear()
+    _model._reset_for_tests()
     cost_table._reset_platform_cache()

@@ -36,14 +36,30 @@ FAMILY_FIELDS = {
     "attention": ("block_q", "block_k"),
     "fused_norm": ("block_r", "block_c"),
     "layernorm": ("block_rows",),
+    # program-level schedule knobs (tune.program) share the store and
+    # its discipline: same schema, same atomicity, same provenance
+    "prog_prefetch": ("depth", "workers"),
+    "prog_scan": ("k",),
+    "prog_zero": ("shard",),
+    "prog_buckets": ("max_bucket", "levels"),
 }
+
+# kernel families a table MISS may trigger a measured kernel search for
+# (tune.search.candidates only knows these; prog_* misses must resolve
+# through tune.program's own search, never a kernel grid)
+KERNEL_FAMILIES = ("attention", "fused_norm", "layernorm")
 
 # the norm kernels hold their working values as fp32 in VMEM regardless
 # of the operand dtype, so their block choice is dtype-blind: the table
 # key pins dtype="float32" for them (an entry baked from bf16 operands
 # serves the f32 run and vice versa — and the offline CLI's default
 # --dtype cannot strand an entry under an unreachable key)
-_KEY_DTYPE = {"fused_norm": "float32", "layernorm": "float32"}
+_KEY_DTYPE = {"fused_norm": "float32", "layernorm": "float32",
+              # program knobs are dtype-blind by construction: their
+              # shapes are workload descriptors (batch, params, dp...),
+              # not array operands
+              "prog_prefetch": "float32", "prog_scan": "float32",
+              "prog_zero": "float32", "prog_buckets": "float32"}
 
 _PLATFORM = {"id": None}
 _platform_lock = threading.Lock()
@@ -103,6 +119,27 @@ def default_table_path() -> str:
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     return os.path.join(root, ".autotune", "cost_table.jsonl")
+
+
+def baked_table_path() -> Optional[str]:
+    """The shipped read-only warm-start table, or None.
+
+    ``MXNET_AUTOTUNE_BAKED`` points at one explicitly; otherwise the
+    repo ships per-platform tables at ``.autotune/baked/<platform>.jsonl``
+    (committed, unlike the writable runtime table) — but ONLY when the
+    runtime table is the default one: a test or operator that repoints
+    ``MXNET_AUTOTUNE_TABLE`` has asked for a hermetic store, and baked
+    entries leaking into it would un-hermeticize every lookup."""
+    env = os.environ.get("MXNET_AUTOTUNE_BAKED")
+    if env:
+        return env
+    if os.environ.get("MXNET_AUTOTUNE_TABLE"):
+        return None
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, ".autotune", "baked",
+                        "%s.jsonl" % platform_id())
+    return path if os.path.exists(path) else None
 
 
 class _file_lock:
@@ -189,12 +226,19 @@ def _read_records(path):
 class CostTable:
     """In-memory view of one on-disk JSONL cost table."""
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 baked: Optional[str] = None):
         self.path = path or default_table_path()
+        # read-only warm-start layer: baked records load first, the
+        # writable file's records override per key, and record() only
+        # ever rewrites the writable file
+        self.baked = baked
         self._lock = threading.Lock()
         self._entries: Dict[tuple, dict] = {}
         self._loaded = False
         self.corrupt = 0
+        # bumped on every record(); model caches key off it
+        self.generation = 0
 
     def _key(self, family, shape, dtype, platform):
         return (family, canon_shape(shape), canon_dtype(dtype, family),
@@ -204,9 +248,16 @@ class CostTable:
         if self._loaded:
             return
         self._loaded = True
-        recs, corrupt = _read_records(self.path)
+        corrupt = 0
+        if self.baked:
+            recs, c = _read_records(self.baked)
+            for key, rec in recs:
+                self._entries[key] = dict(rec, baked=True)
+            corrupt += c
+        recs, c = _read_records(self.path)
         for key, rec in recs:
             self._entries[key] = rec
+        corrupt += c
         self.corrupt += corrupt
         if corrupt:
             from .. import telemetry
@@ -229,11 +280,15 @@ class CostTable:
 
     def record(self, family, shape, dtype, config, best_ms=None,
                source="offline", trials=None, platform=None,
-               interpret=False):
+               interpret=False, results=None):
         """Insert/overwrite one entry and persist the whole table
         atomically (temp sibling + os.replace).  ``interpret`` stamps
         configs chosen from Pallas interpret-mode timings — provenance
-        the lookup uses to refuse serving them on a real chip."""
+        the lookup uses to refuse serving them on a real chip.
+        ``results`` optionally keeps the search's per-candidate timings
+        (``[{"config": {...}, "ms": float}, ...]``, capped at 64) —
+        they are the learned cost model's training set, so a search's
+        losers are worth persisting too."""
         fields = FAMILY_FIELDS[family]
         cfg = {f: int(config[f]) for f in fields}
         rec = {"schema": SCHEMA_VERSION, "family": family,
@@ -247,6 +302,19 @@ class CostTable:
             rec["trials"] = int(trials)
         if interpret:
             rec["interpret"] = True
+        if results:
+            kept = []
+            for r in results:
+                if not isinstance(r, dict) or "ms" not in r:
+                    continue   # errored candidates teach nothing
+                try:
+                    kept.append({"config": {f: int(r["config"][f])
+                                            for f in fields},
+                                 "ms": round(float(r["ms"]), 6)})
+                except (KeyError, TypeError, ValueError):
+                    continue
+            if kept:
+                rec["results"] = kept[:64]
         with self._lock:
             self._load_locked()
             # rebuild-from-disk under a sidecar flock: the file is the
@@ -262,6 +330,7 @@ class CostTable:
                 self._entries[self._key(family, shape, dtype,
                                         platform)] = rec
                 self._write_locked()
+            self.generation += 1
         return rec
 
     def _rebuild_from_disk_locked(self):
@@ -269,8 +338,15 @@ class CostTable:
         records before a rewrite (the caller re-asserts the one key it
         is recording): every on-disk record postdates this process's
         cached view, and a key ABSENT from disk was deleted on purpose
-        — neither may lose to a stale cache."""
-        self._entries = dict(_read_records(self.path)[0])
+        — neither may lose to a stale cache.  The read-only baked layer
+        is re-applied underneath (``baked=True``-marked, so the rewrite
+        below never copies it into the writable file)."""
+        entries = {}
+        if self.baked:
+            for key, r in _read_records(self.baked)[0]:
+                entries[key] = dict(r, baked=True)
+        entries.update(dict(_read_records(self.path)[0]))
+        self._entries = entries
 
     def entries(self):
         with self._lock:
@@ -286,6 +362,8 @@ class CostTable:
         with open(tmp, "w") as fh:
             for _, rec in sorted(self._entries.items(),
                                  key=lambda kv: repr(kv[0])):
+                if rec.get("baked"):
+                    continue   # the shipped layer is read-only
                 fh.write(json.dumps(rec) + "\n")
         os.replace(tmp, self.path)
 

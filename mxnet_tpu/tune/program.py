@@ -1,0 +1,662 @@
+"""Whole-program schedule search: the autotuner, one level up.
+
+The kernel tuner (``tune.search``) picks block shapes inside one
+``pallas_call``; this module applies the same measured discipline to
+the schedule knobs BETWEEN kernels — the whole-system tuning surface of
+arxiv 1605.08695, on the knobs this codebase's telemetry already
+observes:
+
+* ``prog_prefetch`` — ``DevicePrefetchIter`` depth x host decode
+  workers (``(depth, workers)``), keyed on batch size;
+* ``prog_scan`` — ``DataParallelStep.scan_steps`` window ``k`` (steps
+  fused into one compiled program), keyed on (batch, hidden);
+* ``prog_zero`` — ZeRO sharded optimizer update on/off, keyed on
+  (canonical param count, dp extent): the measurement that turns
+  ``shard_optimizer="auto"`` from a heuristic into a decision;
+* ``prog_buckets`` — the serving bucket menu ``(max_bucket, levels)``
+  (a geometric menu, :func:`menu_from_config`), keyed on max batch and
+  pre-validated against the static HBM estimator (``tools.lint.hbm``)
+  before a single executable is compiled.
+
+Everything rides the SAME cost-table store as the kernel families —
+same JSONL schema, same atomic rewrite + sidecar flock, same
+corruption tolerance, same platform/interpret provenance — so one
+table file (and one baked warm-start artifact) carries a program's
+whole tuned schedule.  Search is successive halving over the small
+grids and coordinate descent over the multi-axis ones, both with an
+injectable ``measure(config, calls) -> ms`` so tests are deterministic.
+
+Consumers (``DataParallelStep``, ``Trainer``, ``DevicePrefetchIter``,
+``serve.default_bucket_menu``) resolve through :func:`program_config`,
+which ONLY looks up — a program-knob miss never triggers an implicit
+search (these measures build meshes and spin threads; they run from
+``python -m mxnet_tpu.tune --program`` or a bench, not from a
+constructor) — and every decision is journaled with its
+``tuner_source``.
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import cost_table
+from .cost_table import FAMILY_FIELDS, canon_shape
+
+__all__ = ["PROGRAM_FAMILIES", "heuristic_config", "valid_config",
+           "candidates", "successive_halving", "coordinate_descent",
+           "search_program", "program_config", "program_knobs",
+           "menu_from_config", "config_from_menu", "validate_menu",
+           "canon_param_count", "default_measure", "run_program_search"]
+
+PROGRAM_FAMILIES = ("prog_prefetch", "prog_scan", "prog_zero",
+                    "prog_buckets")
+
+# knob axes (grid per field, deterministic order)
+_AXES = {
+    "prog_prefetch": {"depth": (1, 2, 4, 8), "workers": (1, 2, 4)},
+    "prog_scan": {"k": (1, 2, 4, 8)},
+    "prog_zero": {"shard": (0, 1)},
+}
+
+
+def canon_param_count(n: int) -> int:
+    """Parameter counts round UP to the next power of two before
+    keying ``prog_zero``: the shard/replicate crossover moves with the
+    ORDER of the state size, not its exact value, and exact-count keys
+    would strand every measurement on one net architecture.  Producer
+    (the search CLI / bench) and consumer (``shard_optimizer="auto"``)
+    both canonicalize, so they meet at the same key."""
+    n = max(1, int(n))
+    return 1 << (n - 1).bit_length()
+
+
+def heuristic_config(family: str,
+                     shape: Sequence[int]) -> Dict[str, int]:
+    """Today's hand-derived default for each knob — candidate #0 of
+    every search and the baseline the tuned schedule is A/B'd against."""
+    if family == "prog_prefetch":
+        return {"depth": 2, "workers": 1}      # DevicePrefetchIter's
+    if family == "prog_scan":
+        return {"k": 1}                        # one step per dispatch
+    if family == "prog_zero":
+        # current "auto" heuristic: shard whenever the mesh gives >1 way
+        _, dp = shape
+        return {"shard": 1 if int(dp) > 1 else 0}
+    if family == "prog_buckets":
+        (max_batch,) = shape
+        mb = 1 << max(0, (int(max_batch) - 1).bit_length())
+        levels = min(4, mb.bit_length())       # 8 -> [1, 2, 4, 8]
+        return {"max_bucket": mb, "levels": levels}
+    raise ValueError("unknown program family %r" % (family,))
+
+
+def valid_config(family: str, shape: Sequence[int],
+                 config: Dict[str, int]) -> bool:
+    """Range/consistency predicate for program knobs — the program-side
+    counterpart of the kernels' VMEM predicate: table entries and
+    candidates both pass through here, and an invalid entry falls back
+    to the heuristic instead of wedging a constructor."""
+    try:
+        if family == "prog_prefetch":
+            d, w = int(config["depth"]), int(config["workers"])
+            return 1 <= d <= 64 and 1 <= w <= 32
+        if family == "prog_scan":
+            return 1 <= int(config["k"]) <= 1024
+        if family == "prog_zero":
+            _, dp = shape
+            s = int(config["shard"])
+            # sharding needs >1 way to shard over
+            return s in (0, 1) and (s == 0 or int(dp) > 1)
+        if family == "prog_buckets":
+            mb, lv = int(config["max_bucket"]), int(config["levels"])
+            return mb >= 1 and mb & (mb - 1) == 0 \
+                and 1 <= lv <= mb.bit_length()
+    except (KeyError, TypeError, ValueError):
+        return False
+    return False
+
+
+def candidates(family: str, shape: Sequence[int]) -> List[Dict[str, int]]:
+    """Pruned candidate grid, heuristic first, order deterministic."""
+    heur = heuristic_config(family, shape)
+    out, seen = [], set()
+
+    def add(cfg):
+        key = tuple(sorted(cfg.items()))
+        if key in seen or not valid_config(family, shape, cfg):
+            return
+        seen.add(key)
+        out.append(dict(cfg))
+
+    add(heur)
+    if family == "prog_buckets":
+        mb = heur["max_bucket"]
+        for lv in range(1, mb.bit_length() + 1):
+            add({"max_bucket": mb, "levels": lv})
+    else:
+        axes = _AXES[family]
+        fields = list(FAMILY_FIELDS[family])
+        grids = [axes[f] for f in fields]
+
+        def rec(i, cfg):
+            if i == len(fields):
+                add(dict(cfg))
+                return
+            for v in grids[i]:
+                cfg[fields[i]] = v
+                rec(i + 1, cfg)
+        rec(0, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serving menus
+# ---------------------------------------------------------------------------
+
+def menu_from_config(config: Dict[str, int]) -> List[int]:
+    """The geometric bucket menu a ``prog_buckets`` config denotes:
+    ``levels`` powers of two descending from ``max_bucket`` —
+    ``{max_bucket: 8, levels: 3}`` -> ``[2, 4, 8]``."""
+    mb, lv = int(config["max_bucket"]), int(config["levels"])
+    return sorted(mb >> i for i in range(lv) if mb >> i >= 1)
+
+
+def config_from_menu(menu: Sequence[int]) -> Dict[str, int]:
+    """Inverse of :func:`menu_from_config` for geometric menus (the
+    only shape the table stores)."""
+    menu = sorted(int(b) for b in menu)
+    return {"max_bucket": menu[-1], "levels": len(menu)}
+
+
+def validate_menu(menu: Sequence[int], feature_shape: Sequence[int],
+                  dtype="float32", budget: Optional[int] = None) -> List[int]:
+    """Drop menu buckets whose padded batch I/O cannot fit the serving
+    HBM budget, using the static estimator's arithmetic
+    (``tools.lint.hbm.leaf_bytes_per_chip``): each bucket's executable
+    holds its input and output batch resident, and every bucket's
+    buffers coexist at startup (compile_all touches them all).  Budget:
+    ``MXNET_SERVE_HBM_BUDGET`` bytes, default 2 GiB — deliberately a
+    fraction of a chip, since the model's own weights are not ours to
+    spend.  Largest buckets are dropped first; the menu never empties
+    below its smallest bucket."""
+    try:
+        from tools.lint.hbm import dtype_itemsize
+        item = dtype_itemsize(dtype)
+    except Exception:
+        import numpy as onp
+        item = onp.dtype(dtype).itemsize
+    if budget is None:
+        try:
+            budget = int(os.environ.get("MXNET_SERVE_HBM_BUDGET",
+                                        2 * 1024 ** 3))
+        except ValueError:
+            budget = 2 * 1024 ** 3
+    feat = 1
+    for d in feature_shape:
+        feat *= int(d)
+    menu = sorted(set(int(b) for b in menu if int(b) >= 1))
+    if not menu:
+        return []
+
+    def total(m):
+        return sum(2 * b * feat * item for b in m)   # in + out per bucket
+
+    while len(menu) > 1 and total(menu) > budget:
+        menu.pop()          # largest first
+    return menu
+
+
+# ---------------------------------------------------------------------------
+# search drivers (injectable measure -> deterministic tests)
+# ---------------------------------------------------------------------------
+
+def successive_halving(cands: Sequence[dict],
+                       measure: Callable[[dict, int], float],
+                       rungs: Sequence[int] = (1, 2), keep: float = 0.5):
+    """Time every candidate cheaply, keep the best ``keep`` fraction,
+    re-time the survivors with more calls; repeat per rung.  Returns
+    ``(best_config, best_ms, results, n_measurements)`` or ``(None,
+    None, results, n)`` when nothing measured.  Ties go to the earliest
+    candidate, so a deterministic measure makes the search
+    deterministic."""
+    survivors = [dict(c) for c in cands]
+    order = {tuple(sorted(c.items())): i for i, c in enumerate(survivors)}
+    results, n_meas = [], 0
+    best = None
+    for rung, calls in enumerate(rungs):
+        timed = []
+        for cfg in survivors:
+            try:
+                ms = float(measure(cfg, int(calls)))
+            except Exception as e:
+                results.append({"config": cfg, "rung": rung,
+                                "error": repr(e)[:200]})
+                continue
+            n_meas += 1
+            results.append({"config": cfg, "rung": rung,
+                            "ms": round(ms, 6)})
+            timed.append((ms, order[tuple(sorted(cfg.items()))], cfg))
+        if not timed:
+            return None, None, results, n_meas
+        timed.sort(key=lambda t: (t[0], t[1]))
+        best = timed[0]
+        k = max(1, int(math.ceil(len(timed) * keep)))
+        survivors = [cfg for _, _, cfg in timed[:k]]
+    return dict(best[2]), best[0], results, n_meas
+
+
+def coordinate_descent(init: dict, axes: Dict[str, Sequence[int]],
+                       measure: Callable[[dict, int], float],
+                       calls: int = 2, max_rounds: int = 2,
+                       valid: Optional[Callable[[dict], bool]] = None):
+    """Greedy per-axis descent from ``init``: sweep each knob axis in
+    turn holding the others, adopt any strict improvement, stop when a
+    full round improves nothing.  Configs are measured at most once
+    (memoized).  Returns the same 4-tuple as
+    :func:`successive_halving`."""
+    results, cache = [], {}
+
+    def timed(cfg):
+        key = tuple(sorted(cfg.items()))
+        if key in cache:
+            return cache[key]
+        if valid is not None and not valid(cfg):
+            cache[key] = None
+            return None
+        try:
+            ms = float(measure(dict(cfg), int(calls)))
+        except Exception as e:
+            results.append({"config": dict(cfg), "error": repr(e)[:200]})
+            cache[key] = None
+            return None
+        results.append({"config": dict(cfg), "ms": round(ms, 6)})
+        cache[key] = ms
+        return ms
+
+    cur = dict(init)
+    best_ms = timed(cur)
+    if best_ms is None:
+        return None, None, results, len([r for r in results if "ms" in r])
+    for _ in range(max(1, int(max_rounds))):
+        improved = False
+        for field in sorted(axes):
+            for v in axes[field]:
+                cand = dict(cur, **{field: int(v)})
+                if cand == cur:
+                    continue
+                ms = timed(cand)
+                if ms is not None and ms < best_ms:
+                    cur, best_ms, improved = cand, ms, True
+        if not improved:
+            break
+    n_meas = len([r for r in results if "ms" in r])
+    return cur, best_ms, results, n_meas
+
+
+def search_program(family: str, shape: Sequence[int], measure=None,
+                   calls: int = 2, rungs: Sequence[int] = (1, 2),
+                   keep: float = 0.5, strategy: Optional[str] = None):
+    """Measured search over one program family's knob grid.
+
+    ``measure(config, calls) -> ms`` is injectable (tests); the default
+    is the family's real micro-measurement (:func:`default_measure`).
+    Multi-axis families with more than a handful of candidates descend
+    coordinate-wise from the heuristic; the small grids run successive
+    halving.  Returns the same result-dict shape as
+    ``search.search_config`` (``source: "searched"``) or None."""
+    shape = canon_shape(shape)
+    cands = candidates(family, shape)
+    if not cands:
+        return None
+    if measure is None:
+        measure = default_measure(family, shape)
+    if strategy is None:
+        strategy = "cd" if len(FAMILY_FIELDS[family]) > 1 \
+            and len(cands) > 6 else "sh"
+    if strategy == "cd":
+        axes = _AXES[family]
+        best_cfg, best_ms, results, n = coordinate_descent(
+            cands[0], axes, measure, calls=calls,
+            valid=lambda c: valid_config(family, shape, c))
+    else:
+        best_cfg, best_ms, results, n = successive_halving(
+            cands, measure, rungs=rungs, keep=keep)
+    if best_cfg is None:
+        return None
+    return {"config": dict(best_cfg), "best_ms": best_ms,
+            "source": "searched", "trials": n, "space": len(cands),
+            "strategy": strategy, "interpret": False,
+            "results": results}
+
+
+# ---------------------------------------------------------------------------
+# table consult (lookup ONLY — a miss never searches)
+# ---------------------------------------------------------------------------
+
+def program_config(family: str, shape: Sequence[int],
+                   quiet: bool = False) -> Optional[dict]:
+    """The measured schedule decision for one instance, or None (→
+    caller keeps its heuristic).  Pure lookup + validation: program
+    measures build meshes and spin threads, so a miss NEVER searches
+    inline — ``python -m mxnet_tpu.tune --program`` (or a bench) fills
+    the table offline.  Emits ``autotune.program_hit|miss|fallback``
+    counters and one ``autotune_program`` journal event per decision;
+    ``quiet=True`` is the side-effect-free secondary-lookup spelling."""
+    if family not in PROGRAM_FAMILIES:
+        raise ValueError("unknown program family %r" % (family,))
+    from . import get_table
+    from .. import telemetry
+    shape = canon_shape(shape)
+    rec = get_table().lookup(family, shape, "float32")
+    if rec is not None and valid_config(family, shape, rec["config"]):
+        if not quiet:
+            telemetry.inc("autotune.program_hit")
+            telemetry.event("autotune_program", "hit", family=family,
+                            shape=list(shape), config=rec["config"],
+                            tuner_source="table")
+        return dict(rec["config"], source="table")
+    if quiet:
+        return None
+    if rec is not None:
+        telemetry.inc("autotune.program_fallback")
+        telemetry.event("autotune_program", "fallback", family=family,
+                        shape=list(shape), config=rec["config"],
+                        reason="invalid_table_config",
+                        tuner_source="heuristic")
+    else:
+        telemetry.inc("autotune.program_miss")
+        telemetry.event("autotune_program", "miss", family=family,
+                        shape=list(shape), tuner_source="heuristic")
+    return None
+
+
+def program_knobs(family: str, shape: Sequence[int], default=None,
+                  quiet: bool = False):
+    """Tuned knobs as a tuple in the family's field order
+    (``prog_prefetch`` -> ``(depth, workers)``; single-field families
+    return the scalar), or ``default`` on a miss — the direct-consumer
+    spelling, mirroring ``table_blocks``: graftlint resolves the
+    ``default=`` literal where one feeds kernel sizing."""
+    cfg = program_config(family, shape, quiet=quiet)
+    if cfg is None:
+        return default
+    out = tuple(cfg[f] for f in FAMILY_FIELDS[family])
+    return out if len(out) > 1 else out[0]
+
+
+def record_program(family: str, shape: Sequence[int], res: dict):
+    """Persist one search result under the shared store's discipline."""
+    from . import get_table
+    return get_table().record(
+        family, canon_shape(shape), "float32", res["config"],
+        best_ms=res.get("best_ms"), source=res.get("source", "searched"),
+        trials=res.get("trials"), interpret=res.get("interpret", False),
+        results=res.get("results"))
+
+
+def run_program_search(family: str, shape: Optional[Sequence[int]] = None,
+                       calls: int = 2, record: bool = True, **kw):
+    """Search one family end-to-end (CLI / bench entry): derive the
+    default instance shape when none is given, run the measured search,
+    journal it, and persist the winner."""
+    from .. import telemetry
+    if shape is None:
+        shape = default_shape(family)
+    shape = canon_shape(shape)
+    res = search_program(family, shape, calls=calls, **kw)
+    if res is None:
+        return None
+    telemetry.inc("autotune.program_search")
+    telemetry.event("autotune_program", "search", family=family,
+                    shape=list(shape), config=res["config"],
+                    ms=res["best_ms"], trials=res["trials"],
+                    strategy=res.get("strategy"),
+                    tuner_source="searched")
+    if record:
+        record_program(family, shape, res)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# real measures (CPU-feasible micro-benchmarks of the actual subsystems)
+# ---------------------------------------------------------------------------
+
+_PREFETCH_BATCH = 64          # default instance shapes for the CLI
+_SCAN_SHAPE = (32, 256)       # (batch, hidden)
+_ZERO_SHAPE = (128, 512)      # (batch, hidden) of the probe MLP
+_BUCKETS_MAX = 8
+
+
+def default_shape(family: str) -> Tuple[int, ...]:
+    """The canonical instance each family is tuned at when the CLI is
+    not given an explicit ``--shape``."""
+    if family == "prog_prefetch":
+        return (_PREFETCH_BATCH,)
+    if family == "prog_scan":
+        return _SCAN_SHAPE
+    if family == "prog_zero":
+        import jax
+        batch, hidden = _ZERO_SHAPE
+        return (canon_param_count(_zero_param_count(hidden)),
+                len(jax.local_devices()))
+    if family == "prog_buckets":
+        return (_BUCKETS_MAX,)
+    raise ValueError("unknown program family %r" % (family,))
+
+
+def default_measure(family: str, shape: Sequence[int]):
+    """``measure(config, calls) -> ms`` over the real subsystem."""
+    if family == "prog_prefetch":
+        return lambda cfg, calls: measure_prefetch(
+            cfg["depth"], cfg["workers"], batch_size=shape[0],
+            calls=calls)
+    if family == "prog_scan":
+        return lambda cfg, calls: measure_scan(
+            cfg["k"], batch=shape[0], hidden=shape[1], calls=calls)
+    if family == "prog_zero":
+        return lambda cfg, calls: measure_zero(cfg["shard"],
+                                               calls=calls)
+    if family == "prog_buckets":
+        return lambda cfg, calls: measure_buckets(menu_from_config(cfg),
+                                                  max_batch=shape[0],
+                                                  calls=calls)
+    raise ValueError("unknown program family %r" % (family,))
+
+
+class _DecodeSource:
+    """Synthetic host source standing in for a record-file decoder: one
+    fixed uint8 batch "decoded" (widen + scale) per ``next_host`` call,
+    the work split row-wise across a pool of ``workers`` threads — the
+    knob under test.  Exposes the ``next_host`` fast path
+    ``DevicePrefetchIter`` prefers, so the measured pipeline is the
+    real feeder/ring machinery end to end."""
+
+    def __init__(self, n_batches, batch_size, shape=(3, 32, 32),
+                 workers=1, seed=0):
+        import numpy as onp
+        self.batch_size = int(batch_size)
+        self._shape = tuple(shape)
+        self._raw = onp.random.RandomState(seed).randint(
+            0, 255, (self.batch_size,) + self._shape).astype("uint8")
+        self._lab = onp.zeros((self.batch_size,), "float32")
+        self._n = int(n_batches)
+        self._i = 0
+        self._workers = max(1, int(workers))
+        self._pool = None
+        if self._workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(self._workers)
+
+    def reset(self):
+        self._i = 0
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    def next_host(self):
+        import numpy as onp
+        if self._i >= self._n:
+            raise StopIteration
+        self._i += 1
+        out = onp.empty(self._raw.shape, "float32")
+
+        def work(lo, hi):
+            out[lo:hi] = self._raw[lo:hi].astype("float32")
+            out[lo:hi] *= (1.0 / 255.0)
+        n = len(out)
+        if self._pool is None:
+            work(0, n)
+        else:
+            step = -(-n // self._workers)
+            futs = [self._pool.submit(work, i, min(i + step, n))
+                    for i in range(0, n, step)]
+            for f in futs:
+                f.result()
+        return out, self._lab, 0
+
+
+def measure_prefetch(depth, workers, batch_size=_PREFETCH_BATCH,
+                     n_batches=12, shape=(3, 32, 32), calls=2):
+    """ms per batch through a real ``DevicePrefetchIter`` at (depth,
+    workers), min over ``calls`` epochs."""
+    import time as _time
+    from ..io.device_prefetch import DevicePrefetchIter
+
+    best = None
+    for c in range(max(1, int(calls))):
+        src = _DecodeSource(n_batches, batch_size, shape=shape,
+                            workers=workers)
+        it = DevicePrefetchIter(src, dtype="float32", depth=int(depth))
+        try:
+            t0 = _time.perf_counter()
+            last = None
+            for b in it:
+                last = b.data[0]
+            if last is not None and hasattr(last, "_data"):
+                last._data.block_until_ready()
+            dt = (_time.perf_counter() - t0) * 1e3 / max(1, n_batches)
+        finally:
+            it.close()
+            src.close()
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _zero_param_count(hidden=_ZERO_SHAPE[1]) -> int:
+    # the probe MLP below: 123 -> hidden -> hidden//2 -> 10 dense
+    h2 = hidden // 2
+    return (123 * hidden + hidden) + (hidden * h2 + h2) + (h2 * 10 + 10)
+
+
+def _zero_step(shard, batch, hidden):
+    """One compiled DataParallelStep of the probe MLP (the same net
+    bench.py's zero_sharded_update leg times) + its batch."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    import jax
+
+    n = len(jax.local_devices())
+    mesh = parallel.device_mesh((n,), ("dp",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    onp.random.seed(7)
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"),
+            nn.Dense(hidden // 2, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.rand(batch, 123).astype("float32"))
+    y = mx.nd.array(onp.random.randint(0, 10, (batch,)).astype("float32"))
+    net(x)
+    step = parallel.DataParallelStep(
+        net, lambda o, l: loss_fn(o, l),
+        mx.optimizer.Adam(learning_rate=1e-3), mesh=mesh,
+        shard_optimizer=bool(shard) and n > 1)
+    step(x, y)          # compile + first update
+    return step, (x, y)
+
+
+def measure_zero(shard, batch=_ZERO_SHAPE[0], hidden=_ZERO_SHAPE[1],
+                 calls=2, iters=4):
+    """ms per train step of the probe MLP with the optimizer state
+    replicated (``shard=0``) or ZeRO-sharded (``shard=1``)."""
+    import time as _time
+    step, (x, y) = _zero_step(shard, batch, hidden)
+    best = None
+    for _ in range(max(1, int(calls)) * iters):
+        t0 = _time.perf_counter()
+        step(x, y).asnumpy()
+        dt = (_time.perf_counter() - t0) * 1e3
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def measure_scan(k, batch=_SCAN_SHAPE[0], hidden=_SCAN_SHAPE[1],
+                 calls=2, steps=8):
+    """ms per OPTIMIZER STEP (not per dispatch) of the probe MLP
+    driven through ``scan_steps`` windows of ``k`` — the knob trades
+    per-dispatch host overhead against program size."""
+    import time as _time
+    import numpy as onp
+    import mxnet_tpu as mx
+    step, _ = _zero_step(0, batch, hidden)
+    k = max(1, int(k))
+    xs = mx.nd.array(onp.random.RandomState(1)
+                     .rand(k, batch, 123).astype("float32"))
+    ys = mx.nd.array(onp.random.RandomState(2)
+                     .randint(0, 10, (k, batch)).astype("float32"))
+    step.scan_steps(xs, ys).asnumpy()      # compile the k-window
+    best = None
+    for _ in range(max(1, int(calls))):
+        n_steps = 0
+        t0 = _time.perf_counter()
+        while n_steps < steps:
+            step.scan_steps(xs, ys).asnumpy()
+            n_steps += k
+        dt = (_time.perf_counter() - t0) * 1e3 / n_steps
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def measure_buckets(menu, max_batch=_BUCKETS_MAX, calls=2,
+                    feature=64, hidden=32, n_requests=24):
+    """ms per request trace served over ``menu``: a tiny AOT-compiled
+    MLP dispatches a fixed mixed-size request trace padded onto the
+    menu (the real ``pick_bucket``/``pad_batch``/``AotModel.run``
+    path).  Menus are HBM-validated before any compile."""
+    import time as _time
+    import numpy as onp
+    import jax.numpy as jnp
+    from ..serve import buckets as B
+
+    menu = validate_menu(menu, (feature,), "float32")
+    if not menu:
+        raise ValueError("empty bucket menu after HBM validation")
+    rs = onp.random.RandomState(0)
+    w1 = jnp.asarray(rs.randn(feature, hidden).astype("float32"))
+    w2 = jnp.asarray(rs.randn(hidden, 10).astype("float32"))
+    model = B.AotModel(fn=lambda x: jnp.tanh(x @ w1) @ w2,
+                       feature_shape=(feature,), dtype="float32",
+                       name="progtune")
+    model.compile_all(menu)
+    sizes = [1 + rs.randint(0, max(1, int(max_batch)))
+             for _ in range(n_requests)]
+    rows = {n: [rs.rand(feature).astype("float32") for _ in range(n)]
+            for n in set(sizes)}
+    best = None
+    for _ in range(max(1, int(calls))):
+        t0 = _time.perf_counter()
+        for n in sizes:
+            plan = B.plan_buckets(n, menu) or [menu[-1]]
+            left = n
+            for b in plan:
+                take = min(left, b)
+                x = B.pad_batch(rows[n][:take], b, (feature,), "float32")
+                onp.asarray(model.run(b, x))
+                left -= take
+        dt = (_time.perf_counter() - t0) * 1e3 / len(sizes)
+        best = dt if best is None else min(best, dt)
+    return best

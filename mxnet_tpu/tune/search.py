@@ -26,7 +26,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = ["min_time", "fwd_bwd_loop", "candidates", "heuristic_config",
            "valid_config", "search_config", "measure_attention_config",
-           "attention_loop", "compiled_cost"]
+           "attention_loop", "compiled_cost", "config_vmem_bytes"]
 
 # dispatch-time (on-miss) search budget: at most this many candidates
 # are ever timed per instance unless the caller widens it
@@ -146,6 +146,11 @@ def valid_config(family: str, shape: Sequence[int], dtype,
     graftlint pallas estimator checks statically.  Table entries and
     search candidates both pass through here; an invalid config is a
     heuristic fallback, never a compile attempt."""
+    if family.startswith("prog_"):
+        # program-level knobs validate through their own module (no
+        # VMEM arithmetic; range/shape checks instead)
+        from . import program
+        return program.valid_config(family, shape, config)
     try:
         if family == "attention":
             import jax.numpy as jnp
@@ -175,6 +180,33 @@ def valid_config(family: str, shape: Sequence[int], dtype,
     except (KeyError, TypeError, ValueError):
         return False
     return False
+
+
+def config_vmem_bytes(family: str, shape: Sequence[int], dtype,
+                      config: Dict[str, int]) -> Optional[int]:
+    """The kernel's own static VMEM working-set estimate for a config —
+    the same arithmetic :func:`valid_config` prunes with and the
+    graftlint pallas estimator folds — or None for families without one
+    (program-level knobs).  The learned cost model's strongest feature:
+    time tracks the working set long before it tracks block geometry."""
+    try:
+        if family == "attention":
+            import jax.numpy as jnp
+            from ..ops.pallas_attention import _fwd_vmem_bytes
+            _, _, head_dim = shape
+            Dp = head_dim + (-head_dim) % 64
+            return int(_fwd_vmem_bytes(int(config["block_q"]),
+                                       int(config["block_k"]), Dp,
+                                       jnp.dtype(dtype).itemsize))
+        if family == "fused_norm":
+            return int(config["block_r"]) * int(config["block_c"]) \
+                * 4 * _NORM_N_BUFS
+        if family == "layernorm":
+            _, C = shape
+            return 3 * 4 * int(config["block_rows"]) * int(C)
+    except (KeyError, TypeError, ValueError):
+        return None
+    return None
 
 
 def candidates(family: str, shape: Sequence[int],
@@ -380,9 +412,24 @@ def _measure_candidate(family, shape, dtype, config, calls=DEFAULT_CALLS,
     return s * 1000.0
 
 
+def model_top_k(budget: int) -> int:
+    """How many candidates a model-ranked search actually times: half
+    the v1 budget (``MXNET_AUTOTUNE_MODEL_TOPK`` overrides) — STRICTLY
+    fewer than ``budget`` whenever the budget allows more than one, by
+    the acceptance contract: the model's whole value is timing less."""
+    import os
+    try:
+        k = int(os.environ.get("MXNET_AUTOTUNE_MODEL_TOPK", "0"))
+    except ValueError:
+        k = 0
+    if k <= 0:
+        k = max(1, int(budget) // 2)
+    return max(1, min(k, int(budget)))
+
+
 def search_config(family, shape, dtype, trials=DEFAULT_TRIALS,
                   calls=DEFAULT_CALLS, warmup=DEFAULT_WARMUP, timer=None,
-                  measure=None, interpret=False):
+                  measure=None, interpret=False, model=None, top_k=None):
     """Measured search for one instance.
 
     Enumerates :func:`candidates` (heuristic first), keeps the first
@@ -391,39 +438,97 @@ def search_config(family, shape, dtype, trials=DEFAULT_TRIALS,
 
         {"config": best, "best_ms": float, "source": "searched",
          "trials": n_actually_timed, "space": n_enumerated,
-         "interpret": bool, "results": [...]}
+         "interpret": bool, "ranked": bool, "results": [...]}
 
     or None when nothing could be timed.  ``measure`` overrides the
     per-candidate measurement (tests); ``timer`` reaches the real
     measurement's clock.  Ties go to the earliest candidate, so a
-    deterministic measure makes the search deterministic."""
+    deterministic measure makes the search deterministic.
+
+    When a usable :class:`tune.model.CostModel` is passed, the grid
+    BEYOND the heuristic is reordered by predicted time and only the
+    top-``top_k`` (default :func:`model_top_k` of the budget) are
+    timed — the heuristic itself is always candidate #0, so a wrong
+    model can waste predictions but never lose to v1's baseline.
+    Predicted-vs-measured error is journaled as ``autotune.model_*``
+    telemetry.  A model that raises, or one not ``usable``, falls back
+    to the full log-distance-ordered budget (v1 behaviour, exactly)."""
     cands = candidates(family, shape, dtype)
     if not cands:
         return None
     space = len(cands)
-    if trials is not None:
-        cands = cands[:max(1, int(trials))]
+    budget = max(1, int(trials)) if trials is not None else len(cands)
+    preds = None
+    ranked = False
+    if model is not None and getattr(model, "usable", False):
+        try:
+            preds = [model.predict_config_ms(shape, dtype, c)
+                     for c in cands]
+        except Exception:
+            preds = None
+        if preds is not None:
+            k = int(top_k) if top_k is not None else model_top_k(budget)
+            k = max(1, min(k, budget))
+            order = sorted(range(1, len(cands)),
+                           key=lambda i: (preds[i],
+                                          tuple(sorted(cands[i].items()))))
+            keep = [0] + order
+            pairs = [(cands[i], preds[i]) for i in keep[:k]]
+            cands = [c for c, _ in pairs]
+            preds = [p for _, p in pairs]
+            ranked = True
+    if not ranked:
+        cands = cands[:budget]
     measure = measure or (lambda cfg: _measure_candidate(
         family, shape, dtype, cfg, calls=calls, warmup=warmup,
         timer=timer, interpret=interpret))
     results = []
     best = None
-    for cfg in cands:
+    for i, cfg in enumerate(cands):
         try:
             ms = float(measure(cfg))
         except Exception as e:     # a candidate that fails to compile
             results.append({"config": cfg, "error": repr(e)[:200]})
             continue
-        results.append({"config": cfg, "ms": round(ms, 6)})
+        r = {"config": cfg, "ms": round(ms, 6)}
+        if ranked:
+            r["pred_ms"] = round(float(preds[i]), 6)
+        results.append(r)
         if best is None or ms < best[1]:
             best = (cfg, ms)
     if best is None:
         return None
+    if ranked:
+        _journal_model_error(family, shape, dtype, model, results)
     return {"config": dict(best[0]), "best_ms": best[1],
             "source": "searched",
             "trials": sum(1 for r in results if "ms" in r),
             "space": space, "interpret": bool(interpret),
-            "results": results}
+            "ranked": ranked, "results": results}
+
+
+def _journal_model_error(family, shape, dtype, model, results):
+    """One ``autotune`` / ``model`` event per ranked search: how wrong
+    the predictions were against what was actually measured — the
+    honesty signal ``tools/parse_log.py --jsonl`` renders and the CV
+    gate is calibrated against."""
+    errs = [abs(r["pred_ms"] / r["ms"] - 1.0)
+            for r in results if "ms" in r and "pred_ms" in r
+            and r["ms"] > 0]
+    if not errs:
+        return
+    try:
+        from .. import telemetry
+        telemetry.inc("autotune.model_rank")
+        telemetry.event(
+            "autotune", "model", family=family, shape=list(shape),
+            dtype=str(dtype), n=len(errs),
+            mean_err_pct=round(100.0 * sum(errs) / len(errs), 2),
+            max_err_pct=round(100.0 * max(errs), 2),
+            cv_error=getattr(model, "cv_error", None),
+            n_samples=getattr(model, "n_samples", None))
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------------

@@ -4,12 +4,15 @@ chain.  The pallas checker resolves the lookup's ``default=`` fallback
 config, so the static VMEM rule still rejects an over-budget candidate
 config the search space could otherwise declare — and the pristine twin
 with an in-budget config stays clean (proving the resolution happened:
-without it the stale module defaults would false-positive the twin)."""
+without it the stale module defaults would false-positive the twin).
+The v2 lookups get the same treatment: ``model_blocks`` (learned-model
+fallback, same tuple contract) and ``program_knobs`` (whole-program
+schedule knobs feeding kernel sizing)."""
 import jax
 import jax.numpy as jnp
 import jax.experimental.pallas as pl
 
-from mxnet_tpu.tune import table_blocks
+from mxnet_tpu.tune import model_blocks, program_knobs, table_blocks
 
 _VMEM_CLAMP = 12 * 1024 * 1024
 
@@ -42,5 +45,59 @@ def in_budget_candidate(x):
         grid=(8,),
         in_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((32768, 4096), jnp.bfloat16)],
+    )(x)
+
+
+def over_budget_model_candidate(x):
+    # the model-ranked lookup resolves exactly like the table one: the
+    # default= config is the only one no search machinery validated
+    block_q, block_k = model_blocks("attention", (32768, 4096, 128),
+                                    "bfloat16", default=(4096, 4096))
+    return pl.pallas_call(  # expect: pallas-vmem-budget
+        _k,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((32768, 4096), jnp.bfloat16)],
+    )(x)
+
+
+def in_budget_model_candidate(x):
+    # pristine twin of the model-ranked lookup — must stay clean
+    block_q, block_k = model_blocks("attention", (32768, 4096, 128),
+                                    "bfloat16", default=(512, 1024))
+    return pl.pallas_call(
+        _k,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_q, block_k), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((32768, 4096), jnp.bfloat16)],
+    )(x)
+
+
+def program_knob_feeds_kernel(x):
+    # a whole-program schedule knob feeding kernel sizing: the scan
+    # window scales the row block.  The checker folds program_knobs to
+    # its default= (8) — 8 * 512 rows x 4096 cols of bf16 blows the
+    # 12 MiB clamp at (in + out) alone
+    k = program_knobs("prog_scan", (32, 256), default=8)
+    return pl.pallas_call(  # expect: pallas-vmem-budget
+        _k,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((k * 512, 4096), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((k * 512, 4096), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((32768, 4096), jnp.bfloat16)],
+    )(x)
+
+
+def program_knob_in_budget(x):
+    # pristine twin: default k=1 keeps the block inside the clamp
+    k = program_knobs("prog_scan", (32, 256), default=1)
+    return pl.pallas_call(
+        _k,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((k * 512, 1024), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((k * 512, 1024), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((32768, 4096), jnp.bfloat16)],
     )(x)

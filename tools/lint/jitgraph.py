@@ -674,6 +674,14 @@ class PackageIndex:
     def _arg_untainted(self, cs: CallSite, expr: ast.expr) -> bool:
         if cs.scope is None:
             return _is_scalar_config(expr)
+        if not cs.scope.reachable:
+            # a caller that is not jit-reachable executes host-side
+            # only — its arguments are plain Python values by
+            # construction and cannot carry tracers into the callee.
+            # Without this, host-only entry points (the tune CLI, the
+            # v2 model/program lookup APIs) poison config-hood of the
+            # shared dispatch -> cost-table -> search chain.
+            return True
         t = self.taint(cs.scope)
         return t is not None and not t.expr(expr)
 

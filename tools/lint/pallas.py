@@ -84,15 +84,27 @@ def _global_clamp(index: PackageIndex) -> int:
     return _DEFAULT_CLAMP
 
 
+# the tune-package lookup spellings whose ``default=`` literal is the
+# config a caller is sized at on a miss: the plain table lookup, the
+# v2 model-ranked lookup (same tuple contract, learned-model fallback),
+# and the program-knob lookup (whole-program schedule knobs — folded so
+# a knob that feeds kernel sizing still resolves)
+_TUNE_LOOKUPS = ("table_blocks", "model_blocks", "program_knobs")
+
+
 def _fold_tune_lookup(expr: ast.expr, env) -> Optional[object]:
     """Blocks that arrive via an autotune cost-table lookup instead of a
     literal clamp chain: ``table_blocks(family, shape, dtype,
-    default=(bq, bk))`` (mxnet_tpu.tune) folds to its ``default=``
+    default=(bq, bk))`` (mxnet_tpu.tune) — or its v2 siblings
+    ``model_blocks`` / ``program_knobs`` — folds to its ``default=``
     fallback config — the config the caller is sized at on a table
     miss, and the declared anchor the measured search prunes around
-    with the same VMEM predicate this rule checks statically."""
+    with the same VMEM predicate this rule checks statically.  (The
+    model/table legs only ever serve configs from the statically-pruned
+    candidate grid, so the ``default=`` literal is the one config the
+    lookup can return that the search machinery never validated.)"""
     if not isinstance(expr, ast.Call) or \
-            call_target_name(expr) != "table_blocks":
+            call_target_name(expr) not in _TUNE_LOOKUPS:
         return None
     for kw in expr.keywords:
         if kw.arg == "default":
