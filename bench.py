@@ -646,12 +646,17 @@ def bench_bert(batch_size=24, seq_len=512, dtype="bfloat16", iters=10,
 def bench_telemetry_overhead(batch_size=24, seq_len=512, dtype="bfloat16",
                              iters=10, arch="base"):
     """A/B of the SAME compiled bert_mlm_train step with telemetry OFF
-    vs ON (spans + step hooks + recompile detector + memory-gauge
-    stride all live).  Telemetry is host-side only — the compiled
-    program is identical — so the honest overhead is the host dispatch
-    delta.  ``overhead_pct`` > 2 is a HARD bench failure
-    (_hard_failures): the always-on layer must stay effectively free.
-    Negative deltas are timing noise and clamp to 0."""
+    vs ON (spans + per-step trace contexts + log-bucketed histograms +
+    step hooks + recompile detector + memory-gauge stride all live).
+    Telemetry is host-side only — the compiled program is identical —
+    so the honest overhead is the host dispatch delta.
+    ``overhead_pct`` > 2 is a HARD bench failure (_hard_failures): the
+    always-on layer must stay effectively free.  The artifact proves
+    the ON leg actually exercised the new layers:
+    ``telemetry_hist_count`` is the delta of ``parallel.step``
+    histogram observations and ``telemetry_traced`` asserts the timed
+    steps ran under a live trace context.  Negative deltas are timing
+    noise and clamp to 0."""
     from mxnet_tpu import telemetry
 
     run, _, _ = _build_bert_step(batch_size, seq_len, dtype, arch)
@@ -666,8 +671,16 @@ def bench_telemetry_overhead(batch_size=24, seq_len=512, dtype="bfloat16",
     try:
         before = telemetry.snapshot(events=0)["spans"].get(
             "parallel.step", {}).get("count", 0)
+        h = telemetry.histogram("parallel.step")
+        hist_before = h.count if h is not None else 0
         on_s, _, on_t = _time_calls(run, _sync, warmup=2, iters=iters)
         snap = telemetry.snapshot(events=0)
+        h = telemetry.histogram("parallel.step")
+        hist_after = h.count if h is not None else 0
+        traced = any(
+            r.get("trace") for r in
+            telemetry.snapshot(events=512)["events"]
+            if r.get("kind") == "span" and r.get("name") == "parallel.step")
     finally:
         if not was_enabled:
             telemetry.disable()
@@ -680,7 +693,9 @@ def bench_telemetry_overhead(batch_size=24, seq_len=512, dtype="bfloat16",
             "overhead_ok": overhead <= 2.0,
             "timing_off": off_t, "timing_on": on_t,
             "telemetry_span_count": snap["spans"].get(
-                "parallel.step", {}).get("count", 0) - before}
+                "parallel.step", {}).get("count", 0) - before,
+            "telemetry_hist_count": hist_after - hist_before,
+            "telemetry_traced": bool(traced)}
 
 
 def bench_zero_sharded_update(batch_size=256, hidden=2048, iters=8):
@@ -801,6 +816,8 @@ def bench_checkpoint_overhead(batch_size=256, hidden=512, iters=8,
     step_on, b_on = leg()
     ckpt_dir = tempfile.mkdtemp(prefix="mxtpu_bench_ckpt_")
     writes0 = telemetry.counter("ckpt.writes")
+    h0 = telemetry.histogram("parallel.step")
+    hist_base = h0.to_dict() if h0 is not None else {}
     mgr = checkpoint.CheckpointManager(ckpt_dir, step_on,
                                        every_n_steps=every)
     mgr.attach()
@@ -826,12 +843,19 @@ def bench_checkpoint_overhead(batch_size=256, hidden=512, iters=8,
     writes = telemetry.counter("ckpt.writes") - writes0
     stats = mgr.stats()
     overhead = max(0.0, (ms_on - ms_off) / ms_off * 100.0)
+    # the bench's own steps carved out of the process-lifetime
+    # histogram (earlier jobs' observations subtracted bucket-wise)
+    hw = telemetry.histogram("parallel.step")
+    step_hist = hw.since(hist_base) if hw is not None else None
     return {"bench": "checkpoint_overhead", "batch_size": batch_size,
             "hidden": hidden, "every_n_steps": every, "n_shards": n,
             "window_ms_ckpt_off": round(ms_off, 3),
             "window_ms_ckpt_on": round(ms_on, 3),
             "overhead_pct": round(overhead, 3),
             "overhead_ok": overhead <= 2.0,
+            "step_hist": step_hist.to_dict() if step_hist else None,
+            "step_hist_summary":
+                step_hist.summary() if step_hist else None,
             "ckpt_writes": writes, "ckpt_flushed": bool(flushed),
             "ckpt_bytes": (stats["last_written"] or {}).get("bytes"),
             "ckpt_write_ms": round(
@@ -850,7 +874,11 @@ def bench_serving_latency(rates=(25.0, 100.0, 400.0), duration_s=2.0,
 
     Per rate: p50/p99 terminal latency over completed requests,
     throughput, and the outcome census (results/timeouts/rejects).
-    HARD bench failures (_hard_failures):
+    Percentiles come from the server's own ``serve.request`` telemetry
+    histogram (log-bucketed, fixed memory, mergeable) — each leg is the
+    ``since``-delta against the histogram snapshot taken at leg start,
+    so the bench reads the same digest production scraping would, not
+    a private sample list.  HARD bench failures (_hard_failures):
 
       * ``steady_state_recompiles > 0`` — the telemetry recompile
         detector saw a serve executable compile during the load phase;
@@ -861,7 +889,7 @@ def bench_serving_latency(rates=(25.0, 100.0, 400.0), duration_s=2.0,
         is the server's whole robustness contract.
     """
     import numpy as onp
-    from mxnet_tpu import serve
+    from mxnet_tpu import serve, telemetry
 
     rng = onp.random.RandomState(0)
     w1 = rng.randn(feature, hidden).astype("float32") * 0.05
@@ -876,56 +904,71 @@ def bench_serving_latency(rates=(25.0, 100.0, 400.0), duration_s=2.0,
                             batch_wait_ms=batch_wait_ms,
                             default_deadline_ms=deadline_ms,
                             dispatch_timeout_ms=1000.0)
+    # percentiles come from the live serve.request histogram — under
+    # MXNET_TELEMETRY=0 force telemetry on for the bench's duration so
+    # the latency gate never silently judges an empty digest
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
     srv = serve.InferenceServer(fn, feature_shape=(feature,), config=cfg,
                                 name="serving_bench")
-    t0 = time.perf_counter()
-    srv.start()
-    startup_ms = (time.perf_counter() - t0) * 1e3
-    x = rng.randn(feature).astype("float32")
-    for _ in range(4):          # one warm dispatch before timing
-        srv.submit(x).outcome(timeout=2.0)
 
-    def pct(sorted_ms, p):
-        if not sorted_ms:
+    def _q(hist, q):
+        if hist is None or hist.count == 0:
             return None
-        idx = max(0, min(len(sorted_ms) - 1,
-                         int(round(p / 100.0 * len(sorted_ms))) - 1))
-        return round(sorted_ms[idx], 3)
+        return round(hist.quantile(q), 3)
 
     legs = []
     hangs = 0
-    for rate in rates:
-        n = max(8, int(rate * duration_s))
-        start = time.perf_counter()
-        handles = []
-        for i in range(n):
-            target = start + i / rate
-            now = time.perf_counter()
-            if target > now:
-                time.sleep(target - now)
-            handles.append(srv.submit(x, deadline_ms=deadline_ms))
-        outs = [h.outcome(timeout=deadline_ms / 1e3 + 2.0)
-                for h in handles]
-        elapsed = time.perf_counter() - start
-        kinds = {}
-        for o in outs:
-            k = o[0] if o is not None else "hang"
-            kinds[k] = kinds.get(k, 0) + 1
-        hangs += kinds.get("hang", 0)
-        lats = sorted(h.latency_ms() for h, o in zip(handles, outs)
-                      if o is not None and o[0] == "result")
-        legs.append({
-            "rate_per_s": rate, "n_requests": n,
-            "completed": kinds.get("result", 0),
-            "timeouts": kinds.get("timeout", 0),
-            "rejects": kinds.get("reject", 0),
-            "hangs": kinds.get("hang", 0),
-            "p50_ms": pct(lats, 50), "p99_ms": pct(lats, 99),
-            "throughput_per_s": round(kinds.get("result", 0) / elapsed,
-                                      1)})
-    recompiles = srv.steady_state_recompiles()
-    stats = srv.stats()
-    srv.close()
+    try:
+        t0 = time.perf_counter()
+        srv.start()
+        startup_ms = (time.perf_counter() - t0) * 1e3
+        x = rng.randn(feature).astype("float32")
+        for _ in range(4):          # one warm dispatch before timing
+            srv.submit(x).outcome(timeout=2.0)
+        for rate in rates:
+            n = max(8, int(rate * duration_s))
+            hb = telemetry.histogram("serve.request")
+            base = hb.to_dict() if hb is not None else {}
+            start = time.perf_counter()
+            handles = []
+            for i in range(n):
+                target = start + i / rate
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                handles.append(srv.submit(x, deadline_ms=deadline_ms))
+            outs = [h.outcome(timeout=deadline_ms / 1e3 + 2.0)
+                    for h in handles]
+            elapsed = time.perf_counter() - start
+            kinds = {}
+            for o in outs:
+                k = o[0] if o is not None else "hang"
+                kinds[k] = kinds.get(k, 0) + 1
+            hangs += kinds.get("hang", 0)
+            # this leg's completions, carved bucket-wise out of the
+            # server's lifetime serve.request histogram
+            hh = telemetry.histogram("serve.request")
+            leg_hist = hh.since(base) if hh is not None else None
+            legs.append({
+                "rate_per_s": rate, "n_requests": n,
+                "completed": kinds.get("result", 0),
+                "timeouts": kinds.get("timeout", 0),
+                "rejects": kinds.get("reject", 0),
+                "hangs": kinds.get("hang", 0),
+                "p50_ms": _q(leg_hist, 0.50),
+                "p99_ms": _q(leg_hist, 0.99),
+                "hist":
+                    leg_hist.to_dict() if leg_hist is not None else None,
+                "throughput_per_s": round(
+                    kinds.get("result", 0) / elapsed, 1)})
+        recompiles = srv.steady_state_recompiles()
+        stats = srv.stats()
+        hist_total = telemetry.histogram("serve.request")
+        srv.close()
+    finally:
+        if not was_enabled:
+            telemetry.disable()
     low = legs[0]
     latency_ok = bool(low["p50_ms"]) and low["p99_ms"] is not None \
         and low["p99_ms"] <= 10.0 * low["p50_ms"]
@@ -934,6 +977,11 @@ def bench_serving_latency(rates=(25.0, 100.0, 400.0), duration_s=2.0,
             "deadline_ms": deadline_ms, "batch_wait_ms": batch_wait_ms,
             "startup_compile_ms": round(startup_ms, 1),
             "legs": legs,
+            "latency_source": "histogram",
+            "latency_hist":
+                hist_total.to_dict() if hist_total is not None else None,
+            "latency_hist_summary":
+                hist_total.summary() if hist_total is not None else None,
             "steady_state_recompiles": sum(recompiles.values()),
             "recompile_ok": not recompiles,
             "latency_ok": latency_ok,
@@ -1715,7 +1763,10 @@ def _hard_failures(details):
         so a regressing table entry fails the run (re-tune or delete
         the entry);
       * ``telemetry_overhead`` > 2% — the always-on telemetry layer's
-        whole contract is that it is too cheap to ever turn off;
+        whole contract is that it is too cheap to ever turn off; the
+        ON leg must also PROVE the instrumentation was live (per-step
+        trace contexts observed + histogram counts advanced), else the
+        budget was measured against a dead path;
       * ``checkpoint_overhead`` > 2% — async checkpointing at the
         default cadence must be effectively free on the hot step, or
         nobody leaves durability on in production.
@@ -1728,6 +1779,19 @@ def _hard_failures(details):
                 and d.get("overhead_ok") is False:
             hard.append("telemetry overhead %.2f%% > 2%% on the "
                         "bert_mlm_train step" % d.get("overhead_pct", 0))
+        if d.get("bench") == "telemetry_overhead" \
+                and ("telemetry_hist_count" in d
+                     or "telemetry_traced" in d) \
+                and not (d.get("telemetry_hist_count")
+                         and d.get("telemetry_traced")):
+            # the 2% budget is only meaningful if the ON leg really had
+            # trace contexts + histograms live — a dead instrumentation
+            # path measuring 0% overhead proves nothing
+            hard.append("telemetry overhead leg ran without live "
+                        "instrumentation (hist_count=%s, traced=%s) — "
+                        "the 2%% gate measured a dead path"
+                        % (d.get("telemetry_hist_count"),
+                           d.get("telemetry_traced")))
         if d.get("bench") == "checkpoint_overhead" \
                 and d.get("overhead_ok") is False:
             hard.append("async checkpoint overhead %.2f%% > 2%% at "

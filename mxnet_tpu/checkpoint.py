@@ -54,7 +54,7 @@ from contextlib import contextmanager
 
 import numpy as onp
 
-from . import telemetry
+from . import flight_recorder, telemetry
 from .base import MXNetError
 
 __all__ = ["CheckpointManager", "atomic_path", "read_manifest",
@@ -260,13 +260,18 @@ class CheckpointManager:
             raise MXNetError("CheckpointManager has no target; pass one "
                              "to attach()/save() or the constructor")
         snap = self._target.checkpoint_state()
+        # the caller's trace (usually the step's — save() fires from
+        # the telemetry step hook) rides the queue onto the writer
+        # thread, so ckpt/write events land in the step's trace even
+        # though thread-locals do not cross threads
+        tr = telemetry.current_trace()
         if self._q is None or block:
             self._write(snap, time.perf_counter())
             return True
         try:
             with self._lock:
                 self._pending += 1
-            self._q.put_nowait((snap, time.perf_counter()))
+            self._q.put_nowait((snap, time.perf_counter(), tr))
         except queue.Full:
             with self._lock:
                 self._pending -= 1
@@ -314,17 +319,27 @@ class CheckpointManager:
                 if self._stop.is_set():
                     return
                 continue
+            snap, t_enq, tr = job
             try:
-                self._write(*job)
+                if tr is not None:
+                    # re-enter the saving step's trace on this thread
+                    with telemetry.trace(tr):
+                        self._write(snap, t_enq)
+                else:
+                    self._write(snap, t_enq)
             except Exception as e:
                 # a failed write (disk full, injected crash) must never
                 # kill training: journal it and keep the previous
                 # committed checkpoint in force
                 telemetry.inc("ckpt.write_failures")
                 telemetry.event("ckpt", "write_failed", error=repr(e),
-                                step=int(job[0].get("step", -1)))
+                                step=int(snap.get("step", -1)),
+                                **({"trace": tr} if tr else {}))
                 with self._lock:
                     self._last_error = repr(e)
+                flight_recorder.dump_incident(
+                    "ckpt_write_failed", detail=repr(e),
+                    extra={"step": int(snap.get("step", -1))})
             finally:
                 with self._lock:
                     self._pending -= 1

@@ -536,12 +536,15 @@ class Trainer:
         of requiring manual tic/toc."""
         # memory sampled on a stride (first step always): the allocator
         # query is a runtime call, not worth paying on every fast step
-        with telemetry.span("trainer.step",
-                            memory=(self._step_count % 8 == 0)) as _sp:
-            self._step_impl(batch_size, ignore_stale_grad)
-        telemetry.emit_step("trainer", self._step_count,
-                            batch_size=batch_size,
-                            step_ms=_sp.duration_ms, owner=self)
+        # — one trace per step: nested spans (fused update, checkpoint
+        # save from the step hook) and events share the step's trace id
+        with telemetry.trace():
+            with telemetry.span("trainer.step", hist=True,
+                                memory=(self._step_count % 8 == 0)) as _sp:
+                self._step_impl(batch_size, ignore_stale_grad)
+            telemetry.emit_step("trainer", self._step_count,
+                                batch_size=batch_size,
+                                step_ms=_sp.duration_ms, owner=self)
         self._step_count += 1
 
     def _step_impl(self, batch_size, ignore_stale_grad):

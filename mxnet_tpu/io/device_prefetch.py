@@ -323,8 +323,8 @@ class DevicePrefetchIter(DataIter):
                 # normalize dispatch) img/s for the LAST batch — the
                 # numbers the bench sweep derives, now live at runtime
                 n = host[0].shape[0]
-                telemetry.observe("prefetch.host", host_s)
-                telemetry.observe("prefetch.ship", ship_s)
+                telemetry.observe("prefetch.host", host_s, hist=True)
+                telemetry.observe("prefetch.ship", ship_s, hist=True)
                 if host_s > 0:
                     telemetry.gauge("prefetch.host_rate_img_s",
                                     round(n / host_s, 1))
@@ -446,7 +446,7 @@ class DevicePrefetchIter(DataIter):
         t0 = time.perf_counter()
         kind, payload = q.get()
         telemetry.observe("prefetch.consumer_wait",
-                          time.perf_counter() - t0)
+                          time.perf_counter() - t0, hist=True)
         if kind == _BATCH:
             telemetry.inc("prefetch.batches")
         if kind in (_END, _ERR):

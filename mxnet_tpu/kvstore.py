@@ -267,6 +267,11 @@ class KVStoreTPU(KVStoreLocal):
 
     def __init__(self, type_str="tpu"):
         super().__init__(type_str)
+        # from here on every telemetry record is rank-stamped: the
+        # per-rank JSONL exports become self-identifying to
+        # ``python -m mxnet_tpu.telemetry_collect``
+        from . import telemetry
+        telemetry.set_rank(self.rank)
         _start_liveness_heartbeat()
 
     def close(self):

@@ -21,6 +21,10 @@ moment the real failure would land:
 * ``checkpoint_write_crash`` — ``checkpoint.atomic_path`` raises
   between the tmp write and the ``os.replace`` commit: the crash window
   atomicity exists to survive.
+* ``incident_write_crash``   — ``flight_recorder.dump_incident`` raises
+  between building the bundle and its ``os.replace`` publish: same
+  crash window, same discipline — a reader must never see a partial
+  incident bundle and the tmp must not leak.
 
 Serving faults (consulted by ``mxnet_tpu.serve.server`` — the chaos
 matrix in tests/test_serve_chaos.py drives all four):

@@ -653,11 +653,15 @@ class DataParallelStep:
         # memory_stats() is a runtime call, and this step is the hot
         # path the 2% telemetry-overhead gate protects
         idx = self._t   # 0-based index of THIS step (inner advances it)
-        with telemetry.span("parallel.step",
-                            memory=(idx % 32 == 0)) as _sp:
-            out = self._dispatch_inner(data, label, scan)
-        telemetry.emit_step("parallel", idx, step_ms=_sp.duration_ms,
-                            owner=self)
+        # trace() JOINS an enclosing trace (a Trainer-driven step) and
+        # opens a fresh one per step otherwise, so every step's spans
+        # and step event are causally linked either way
+        with telemetry.trace():
+            with telemetry.span("parallel.step", hist=True,
+                                memory=(idx % 32 == 0)) as _sp:
+                out = self._dispatch_inner(data, label, scan)
+            telemetry.emit_step("parallel", idx, step_ms=_sp.duration_ms,
+                                owner=self)
         return out
 
     def _dispatch_inner(self, data, label, scan):

@@ -40,7 +40,7 @@ import time
 
 import jax
 
-from .. import telemetry
+from .. import flight_recorder, telemetry
 from ..base import MXNetError
 from .mesh import device_mesh, get_mesh, set_mesh
 
@@ -167,6 +167,7 @@ class ElasticContext:
                 and now - self._last_probe < self._poll_interval:
             return None
         self._last_probe = now
+        t0 = time.perf_counter()
         try:
             dead = kv_retry(self._probe, retries=self._retries,
                             base=self._base, cap=self._cap,
@@ -188,7 +189,20 @@ class ElasticContext:
         telemetry.event("elastic", "detect", step=step, change=kind,
                         n_dead=dead, world_from=ev["world_from"],
                         world_to=ev["world_to"])
+        telemetry.span_event("elastic.detect",
+                             time.perf_counter() - t0, step=step,
+                             change=kind)
         self._dead = dead
+        if kind == "departed":
+            # every survivor freezes a postmortem bundle at the moment
+            # of detection: which peer vanished, the journal tail, the
+            # heartbeat/kv counters — recoverable even if the re-shard
+            # that follows goes wrong too
+            flight_recorder.dump_incident(
+                "elastic_departure",
+                detail="world %d -> %d at step %r"
+                       % (ev["world_from"], ev["world_to"], step),
+                extra=dict(ev))
         if self.world < self._min_workers:
             raise MXNetError(
                 "elastic: %d live workers < min_workers=%d — restart "
@@ -216,20 +230,35 @@ class ElasticContext:
         t0 = time.perf_counter()
         moved = self._target.reshard(mesh)
         set_mesh(mesh)
+        dur_s = time.perf_counter() - t0
         telemetry.inc("elastic.reshards")
         telemetry.event("elastic", "reshard", step=step,
                         world_from=old_n, world_to=int(mesh.size),
                         bytes=int(moved or 0),
-                        dur_ms=round((time.perf_counter() - t0) * 1e3, 3))
+                        dur_ms=round(dur_s * 1e3, 3))
+        telemetry.span_event("elastic.reshard", dur_s, step=step,
+                             world_to=int(mesh.size))
         return mesh
 
     def maybe_recover(self, devices=None, step=None):
         """poll() + reform() in one call — the per-step guard a training
         loop runs.  Only a departure triggers re-formation; joins and
         coordinator loss are reported for the caller to act on (grow /
-        restore at the next checkpoint boundary)."""
-        ev = self.poll(step=step)
-        if ev is not None and ev["kind"] == "departed" \
-                and self._target is not None:
-            ev["mesh"] = self.reform(devices=devices, step=step)
+        restore at the next checkpoint boundary).
+
+        The whole recovery runs inside one trace context: the
+        ``elastic.detect`` / ``elastic.reshard`` spans, the journal
+        events they bracket, and the closing ``elastic.resume`` span
+        share a trace id — the collector-merged timeline shows one
+        causally-linked recovery per survivor."""
+        with telemetry.trace():
+            t0 = time.perf_counter()
+            ev = self.poll(step=step)
+            if ev is not None and ev["kind"] == "departed" \
+                    and self._target is not None:
+                ev["mesh"] = self.reform(devices=devices, step=step)
+                telemetry.span_event("elastic.resume",
+                                     time.perf_counter() - t0,
+                                     step=step,
+                                     world_to=int(ev["mesh"].size))
         return ev

@@ -42,7 +42,7 @@ import time
 
 import numpy as onp
 
-from .. import telemetry
+from .. import flight_recorder, telemetry
 from ..base import MXNetError
 from ..parallel import chaos
 from .buckets import AotModel, pad_batch, plan_buckets
@@ -129,6 +129,10 @@ class PendingRequest:
         self.priority = int(priority)
         self.synthetic = bool(synthetic)
         self.arrival = time.monotonic()
+        # the trace id follows this request across batcher -> dispatch
+        # -> terminal outcome: every journal record stamped with it is
+        # one causally-linked story in the collector's merged timeline
+        self.trace_id = telemetry.new_trace_id()
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._outcome = None
@@ -140,8 +144,14 @@ class PendingRequest:
             if self._outcome is not None:
                 return False
             self._outcome = (kind, value, reason)
-            self._done_ts = time.monotonic()
+            done_ts = self._done_ts = time.monotonic()
         self._done.set()
+        lat_ms = (done_ts - self.arrival) * 1e3
+        if kind == "result" and not self.synthetic:
+            telemetry.hist_observe("serve.request", lat_ms)
+        telemetry.event("serve", "outcome", trace=self.trace_id,
+                        outcome=kind, reason=reason,
+                        latency_ms=round(lat_ms, 3))
         return True
 
     def done(self):
@@ -393,6 +403,9 @@ class InferenceServer:
         req = PendingRequest(arr, time.monotonic() + deadline_s,
                              priority=priority)
         telemetry.inc("serve.requests")
+        telemetry.event("serve", "request", trace=req.trace_id,
+                        deadline_ms=round(deadline_s * 1e3, 3),
+                        priority=priority)
         if tuple(arr.shape) != feat:
             self._reject(req, "bad_shape: %r != %r"
                          % (tuple(arr.shape), feat))
@@ -635,7 +648,8 @@ class InferenceServer:
                 attempts += 1
                 telemetry.inc("serve.dispatch_errors")
                 telemetry.event("serve", "dispatch_error", bucket=bucket,
-                                attempt=attempts, error=repr(e))
+                                attempt=attempts, error=repr(e),
+                                traces=[r.trace_id for r in part])
                 if abandoned:
                     return
                 if attempts <= self._cfg.max_retries:
@@ -650,10 +664,22 @@ class InferenceServer:
             abandoned = self._unregister_inflight(did) is None
             if abandoned:
                 return                   # watchdog resolved these already
+            dispatch_s = time.monotonic() - t0
             n = 0
             for j, r in enumerate(part):
                 if r._resolve("result", value=out[j]):
                     n += 1
+            # per-request queue-wait phase (trace-linked) + the shared
+            # execute phase: with the terminal outcome event these make
+            # one request's submit -> wait -> execute -> outcome story
+            for r in part:
+                telemetry.span_event("serve.queue_wait",
+                                     max(0.0, t0 - r.arrival),
+                                     trace=r.trace_id, hist=True,
+                                     bucket=bucket)
+            telemetry.span_event("serve.dispatch", dispatch_s, hist=True,
+                                 bucket=bucket, n=len(part),
+                                 traces=[r.trace_id for r in part])
             depth = self._q.qsize()
             telemetry.inc("serve.dispatches")
             telemetry.inc("serve.results", n)
@@ -664,7 +690,7 @@ class InferenceServer:
                 queue_depth=depth,
                 wait_ms=round((t0 - min(r.arrival for r in part)) * 1e3,
                               3),
-                dispatch_ms=round((time.monotonic() - t0) * 1e3, 3))
+                dispatch_ms=round(dispatch_s * 1e3, 3))
             return
 
     def _fail_requests(self, reqs, reason):
@@ -682,6 +708,17 @@ class InferenceServer:
             telemetry.event("serve", "quarantine", bucket=bucket,
                             error=repr(error))
         self._set_state(DEGRADED)
+        if fresh:
+            # postmortem artifact AFTER the journal records the
+            # quarantine + DEGRADED transition: the bundle's journal
+            # tail holds the dispatch_error events (with the affected
+            # requests' trace ids), the failing bucket and the
+            # state change — the poisoned-executable story, recoverable
+            # offline
+            flight_recorder.dump_incident(
+                "serve_quarantine",
+                detail="bucket %d quarantined: %r" % (bucket, error),
+                extra={"model": self.name, "bucket": bucket})
 
     def reset_quarantine(self):
         """Operator knob (overload runbook): re-admit quarantined
@@ -760,6 +797,15 @@ class InferenceServer:
         if can_respawn:
             self._spawn_dispatcher(gen)
         self._set_state(DEGRADED)
+        flight_recorder.dump_incident(
+            "serve_respawn_exhausted" if not can_respawn
+            else "serve_watchdog",
+            detail="dispatch stuck %.1f ms on bucket %d"
+                   % ((now - rec["start"]) * 1e3, rec["bucket"]),
+            extra={"model": self.name, "bucket": rec["bucket"],
+                   "timed_out_requests": n,
+                   "traces": [r.trace_id for r in rec["reqs"]],
+                   "respawned": bool(can_respawn)})
 
     def _maybe_recover(self):
         """DEGRADED -> READY once the queue subsides below the resume

@@ -31,6 +31,15 @@ Primitives
 * ``sample_memory()`` — gauges for device ``memory_stats()`` bytes and
   host RSS; sampled automatically at ``span(..., memory=True)``
   boundaries (the trainer step does this).
+* ``trace()`` / ``span_event()`` / ``set_rank()`` — trace-context
+  propagation (ISSUE 18): a thread-local ``trace_id`` stamps every
+  span/event inside the context, spans chain ``sid``/``parent``, and
+  the distributed rank rides on every record so per-rank JSONL exports
+  merge into one causally-linked timeline
+  (``python -m mxnet_tpu.telemetry_collect``).
+* ``hist_observe()`` / ``Histogram`` — online log-bucketed histograms:
+  fixed memory forever, mergeable across processes, honest p50/p99
+  without raw sample lists (``bench.py serving_latency`` reads these).
 
 Exporters
 ---------
@@ -48,14 +57,18 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import threading
 import time
 from collections import deque
 
 __all__ = [
-    "span", "observe", "inc", "counter", "gauge", "event", "snapshot",
-    "reset", "enabled", "enable", "disable", "disabled",
+    "span", "observe", "span_event", "inc", "counter", "gauge", "event",
+    "snapshot", "reset", "enabled", "enable", "disable", "disabled",
+    "trace", "current_trace", "current_span", "new_trace_id",
+    "set_rank", "get_rank", "sync_clock",
+    "Histogram", "hist_observe", "histogram", "hist_snapshot",
     "record_compile", "compile_counts", "compile_deltas",
     "sample_memory",
     "add_step_hook", "remove_step_hook", "emit_step",
@@ -79,8 +92,13 @@ _gauges = {}
 _spans = {}          # name -> [count, total_s, min_s, max_s, last_s]
 _journal = deque(maxlen=JOURNAL_MAXLEN)
 _compiles = {}       # fn -> {"count": int, "key": last_key}
+_retrace_warned = set()   # (fn, changed-leaf family) already warned
+_hists = {}          # name -> Histogram
 _step_hooks = []
 _jsonl = {"path": None, "fh": None}
+_rank = None         # distributed rank stamped on every journal record
+_tls = threading.local()     # .trace = active trace id, .span = span id
+_ids = [0]           # process-local trace/span id counter (under _lock)
 
 
 def _now():
@@ -120,12 +138,104 @@ class disabled:
 
 
 # ---------------------------------------------------------------------------
+# rank / trace context
+# ---------------------------------------------------------------------------
+
+def set_rank(rank):
+    """Stamp ``rank`` on every subsequent journal record.  Called once
+    per process by the distributed bootstrap (``kvstore.create``) so
+    per-rank JSONL exports are self-identifying to the collector."""
+    global _rank
+    _rank = rank
+
+
+def get_rank():
+    return _rank
+
+
+def _next_id():
+    with _lock:
+        _ids[0] += 1
+        return _ids[0]
+
+
+def new_trace_id():
+    """Process-unique trace id (pid-qualified, so ids from different
+    ranks never collide in a collector merge)."""
+    return "%x-%x-%x" % (int(_WALL0 * 1e3) & 0xffffffff,
+                         os.getpid() & 0xffffff, _next_id())
+
+
+def current_trace():
+    """The trace id active on this thread, or None."""
+    return getattr(_tls, "trace", None)
+
+
+def current_span():
+    """The span id of the innermost open traced span on this thread."""
+    return getattr(_tls, "span", None)
+
+
+class _ActiveTrace:
+    __slots__ = ("trace_id", "_prev_trace", "_prev_span")
+
+    def __init__(self, trace_id):
+        self.trace_id = trace_id
+
+    def __enter__(self):
+        self._prev_trace = getattr(_tls, "trace", None)
+        self._prev_span = getattr(_tls, "span", None)
+        _tls.trace = self.trace_id
+        _tls.span = None
+        return self
+
+    def __exit__(self, *a):
+        _tls.trace = self._prev_trace
+        _tls.span = self._prev_span
+        return False
+
+
+class _NoopTrace:
+    __slots__ = ("trace_id",)
+
+    def __enter__(self):
+        # joining an already-active trace: expose its id
+        self.trace_id = getattr(_tls, "trace", None)
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def trace(trace_id=None):
+    """``with telemetry.trace(): ...`` — open a trace context on this
+    thread.  Spans and events inside carry ``trace`` (and spans a
+    ``sid``/``parent`` chain), so one request or one training step is
+    causally linked end to end.
+
+    With no explicit id, an already-active trace is JOINED (no-op): a
+    ``DataParallelStep`` dispatched from inside ``Trainer.step`` shares
+    the step's trace instead of opening a nested one.  An explicit
+    ``trace_id`` always activates (serve worker threads re-enter a
+    request's trace from the PendingRequest)."""
+    if not _enabled:
+        return _NoopTrace()
+    if trace_id is None:
+        if getattr(_tls, "trace", None) is not None:
+            return _NoopTrace()
+        trace_id = new_trace_id()
+    return _ActiveTrace(trace_id)
+
+
+# ---------------------------------------------------------------------------
 # journal
 # ---------------------------------------------------------------------------
 
 def _emit(rec):
     """Append to the journal (and the streaming JSONL sink, if set).
     Caller holds no lock; rec must already carry ``ts``."""
+    if _rank is not None:
+        rec.setdefault("rank", _rank)
     with _lock:
         _journal.append(rec)
         fh = _jsonl["fh"]
@@ -140,20 +250,50 @@ def _emit(rec):
 
 
 def event(kind, name, **data):
-    """Record a structured event in the bounded journal."""
+    """Record a structured event in the bounded journal.  Inside an
+    active trace context the record carries the trace id."""
     if not _enabled:
         return
     rec = {"ts": round(_WALL0 + _now(), 6), "kind": kind, "name": name}
+    tr = getattr(_tls, "trace", None)
+    if tr is not None and "trace" not in data:
+        rec["trace"] = tr
     if data:
         rec.update(data)
     _emit(rec)
+
+
+def sync_clock(client, rank, key="mxtpu/clock0", timeout_ms=10000):
+    """Cross-process clock alignment via the coordination KV store:
+    rank 0 publishes its (monotonic-anchored) wall clock; every rank
+    journals a ``clock`` record pairing that reference with its own
+    local clock.  ``telemetry_collect`` subtracts the pair per export
+    file to de-skew all ranks onto rank 0's timeline."""
+    if not _enabled:
+        return None
+    ref = None
+    if rank == 0:
+        ref = _WALL0 + _now()
+        try:
+            client.key_value_set(key, repr(ref))
+        except Exception:
+            ref = None
+    else:
+        try:
+            ref = float(client.blocking_key_value_get(key, timeout_ms))
+        except Exception:
+            ref = None
+    local = _WALL0 + _now()
+    event("clock", "sync", rank=rank, local_wall=round(local, 6),
+          ref_wall=round(ref, 6) if ref is not None else None)
+    return ref
 
 
 # ---------------------------------------------------------------------------
 # spans
 # ---------------------------------------------------------------------------
 
-def _record_span(name, start, dur_s, journal=True):
+def _record_span_agg(name, dur_s):
     with _lock:
         agg = _spans.get(name)
         if agg is None:
@@ -164,31 +304,60 @@ def _record_span(name, start, dur_s, journal=True):
             agg[2] = min(agg[2], dur_s)
             agg[3] = max(agg[3], dur_s)
             agg[4] = dur_s
+
+
+def _record_span(name, start, dur_s, journal=True, trace=None, sid=None,
+                 parent=None):
+    _record_span_agg(name, dur_s)
     if journal:
-        _emit({"ts": round(_WALL0 + start, 6), "kind": "span",
+        rec = {"ts": round(_WALL0 + start, 6), "kind": "span",
                "name": name, "dur_ms": round(dur_s * 1e3, 4),
-               "tid": threading.get_ident()})
+               "tid": threading.get_ident()}
+        if trace is not None:
+            rec["trace"] = trace
+            if sid is not None:
+                rec["sid"] = sid
+            if parent is not None:
+                rec["parent"] = parent
+        _emit(rec)
 
 
 class _Span:
-    """Scoped wall-time timer.  ``duration_ms`` is readable after exit."""
+    """Scoped wall-time timer.  ``duration_ms`` is readable after exit.
+    Inside an active trace context the journal record carries the trace
+    id plus a ``sid``/``parent`` chain (nested spans link causally)."""
 
-    __slots__ = ("name", "memory", "_t0", "duration_ms")
+    __slots__ = ("name", "memory", "hist", "_t0", "duration_ms",
+                 "_trace", "_sid", "_parent")
 
-    def __init__(self, name, memory=False):
+    def __init__(self, name, memory=False, hist=False):
         self.name = name
         self.memory = memory
+        self.hist = hist
         self._t0 = None
         self.duration_ms = None
+        self._trace = None
+        self._sid = None
+        self._parent = None
 
     def __enter__(self):
+        self._trace = getattr(_tls, "trace", None)
+        if self._trace is not None:
+            self._parent = getattr(_tls, "span", None)
+            self._sid = _next_id()
+            _tls.span = self._sid
         self._t0 = _now()
         return self
 
     def __exit__(self, *a):
         dur = _now() - self._t0
         self.duration_ms = dur * 1e3
-        _record_span(self.name, self._t0, dur)
+        if self._trace is not None:
+            _tls.span = self._parent
+        _record_span(self.name, self._t0, dur, trace=self._trace,
+                     sid=self._sid, parent=self._parent)
+        if self.hist:
+            hist_observe(self.name, dur * 1e3)
         if self.memory:
             sample_memory()
         return False
@@ -207,19 +376,50 @@ class _NoopSpan:
         return False
 
 
-def span(name, memory=False):
-    """``with telemetry.span("step"): ...`` — time a scope."""
+def span(name, memory=False, hist=False):
+    """``with telemetry.span("step"): ...`` — time a scope.  With
+    ``hist=True`` the duration also feeds the ``name`` histogram."""
     if not _enabled:
         return _NoopSpan()
-    return _Span(name, memory=memory)
+    return _Span(name, memory=memory, hist=hist)
 
 
-def observe(name, dur_s):
+def observe(name, dur_s, hist=False):
     """Record an externally-measured duration into the span aggregates
     (for stages timed by hand, e.g. inside the prefetch feeder loop)."""
     if not _enabled:
         return
     _record_span(name, _now() - dur_s, dur_s, journal=False)
+    if hist:
+        hist_observe(name, dur_s * 1e3)
+
+
+def span_event(name, dur_s, trace=None, parent=None, hist=False, **data):
+    """Journal an externally-timed span with EXPLICIT trace linkage.
+
+    The serve pipeline and the elastic runtime measure phases whose
+    start and end live on different threads (queue wait, dispatch,
+    detect -> reshard -> resume) — no thread-local context covers them,
+    so the caller passes the trace id it carried on the request or the
+    recovery event.  Updates the span aggregates like ``observe`` and,
+    with ``hist=True``, the ``name`` histogram."""
+    if not _enabled:
+        return
+    start = _now() - dur_s
+    _record_span_agg(name, dur_s)
+    rec = {"ts": round(_WALL0 + start, 6), "kind": "span", "name": name,
+           "dur_ms": round(dur_s * 1e3, 4), "tid": threading.get_ident()}
+    if trace is None:
+        trace = getattr(_tls, "trace", None)
+    if trace is not None:
+        rec["trace"] = trace
+    if parent is not None:
+        rec["parent"] = parent
+    if data:
+        rec.update(data)
+    _emit(rec)
+    if hist:
+        hist_observe(name, dur_s * 1e3)
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +446,167 @@ def gauge(name, value):
         return
     with _lock:
         _gauges[name] = value
+
+
+# ---------------------------------------------------------------------------
+# online histograms
+# ---------------------------------------------------------------------------
+
+class Histogram:
+    """Log-bucketed online histogram: fixed memory, mergeable.
+
+    Buckets are logarithmic — ``BUCKETS_PER_DECADE`` per power of ten
+    from ``LO`` up through ``LO * 10**DECADES`` (default 1e-3..1e7 ms,
+    i.e. 1 microsecond to ~3 hours when fed milliseconds), plus one
+    underflow bucket.  Relative quantile error is bounded by the bucket
+    ratio (~12% at 10/decade) and exact min/max are kept, so p50/p99
+    are honest without storing samples: the bucket array is allocated
+    once at a fixed ``NBUCKETS`` length and NEVER grows — memory is
+    byte-for-byte identical after 10 observations or 10 million.
+
+    Two histograms with the same parameters merge by adding counts,
+    which is how ``telemetry_collect`` combines per-rank exports and
+    how bench diffs a leg (``since``) out of a long-lived server."""
+
+    LO = 1e-3
+    BUCKETS_PER_DECADE = 10
+    DECADES = 10
+    NBUCKETS = 1 + BUCKETS_PER_DECADE * DECADES   # +1 underflow
+
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self.buckets = [0] * self.NBUCKETS
+
+    def _index(self, v):
+        if v < self.LO:
+            return 0
+        return 1 + min(self.NBUCKETS - 2,
+                       int(math.log10(v / self.LO)
+                           * self.BUCKETS_PER_DECADE))
+
+    def add(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self.buckets[self._index(v)] += 1
+
+    def _bound(self, i):
+        """Upper edge of bucket ``i``."""
+        if i == 0:
+            return self.LO
+        return self.LO * 10.0 ** (i / self.BUCKETS_PER_DECADE)
+
+    def quantile(self, q):
+        """Value at quantile ``q`` in [0, 1]: the geometric midpoint of
+        the bucket holding the q-th observation, clamped by the exact
+        min/max.  None on an empty histogram."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.buckets):
+            seen += c
+            if seen >= target and c:
+                lo = self._bound(i - 1) if i > 0 else 0.0
+                hi = self._bound(i)
+                mid = math.sqrt(lo * hi) if lo > 0 else hi / 2.0
+                return max(self.min, min(self.max, mid))
+        return self.max
+
+    def merge(self, other):
+        """Add ``other``'s counts into this histogram (same geometry)."""
+        if other.count == 0:
+            return self
+        self.count += other.count
+        self.sum += other.sum
+        self.min = other.min if self.min is None else min(self.min,
+                                                          other.min)
+        self.max = other.max if self.max is None else max(self.max,
+                                                          other.max)
+        for i, c in enumerate(other.buckets):
+            self.buckets[i] += c
+        return self
+
+    def since(self, baseline):
+        """A new Histogram holding only what arrived after ``baseline``
+        (an earlier ``to_dict`` snapshot of THIS histogram) — bench
+        carves one load leg out of a long-lived server's totals.
+        min/max are the lifetime values (bounds, not leg-exact)."""
+        out = Histogram()
+        base = {int(k): v for k, v in baseline.get("buckets", {}).items()}
+        out.count = self.count - baseline.get("count", 0)
+        out.sum = self.sum - baseline.get("sum", 0.0)
+        out.min, out.max = self.min, self.max
+        for i, c in enumerate(self.buckets):
+            out.buckets[i] = c - base.get(i, 0)
+        return out
+
+    def to_dict(self):
+        """JSON form: sparse non-zero buckets + geometry for merge
+        validation."""
+        return {"count": self.count, "sum": round(self.sum, 6),
+                "min": self.min, "max": self.max,
+                "lo": self.LO, "bpd": self.BUCKETS_PER_DECADE,
+                "buckets": {str(i): c for i, c in enumerate(self.buckets)
+                            if c}}
+
+    @classmethod
+    def from_dict(cls, d):
+        if (d.get("lo", cls.LO) != cls.LO
+                or d.get("bpd", cls.BUCKETS_PER_DECADE)
+                != cls.BUCKETS_PER_DECADE):
+            raise ValueError("histogram geometry mismatch: %r" % d)
+        h = cls()
+        h.count = int(d.get("count", 0))
+        h.sum = float(d.get("sum", 0.0))
+        h.min = d.get("min")
+        h.max = d.get("max")
+        for k, c in d.get("buckets", {}).items():
+            h.buckets[int(k)] = int(c)
+        return h
+
+    def summary(self):
+        """Quantile digest for snapshots and parse_log tables."""
+        if self.count == 0:
+            return {"count": 0}
+        return {"count": self.count,
+                "mean": round(self.sum / self.count, 4),
+                "min": round(self.min, 4), "max": round(self.max, 4),
+                "p50": round(self.quantile(0.50), 4),
+                "p90": round(self.quantile(0.90), 4),
+                "p99": round(self.quantile(0.99), 4)}
+
+
+def hist_observe(name, value_ms):
+    """Feed one observation (milliseconds by convention) into the
+    ``name`` histogram, creating it on first use."""
+    if not _enabled:
+        return
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = Histogram()
+        h.add(value_ms)
+
+
+def histogram(name):
+    """The live Histogram for ``name`` (None if never observed)."""
+    with _lock:
+        return _hists.get(name)
+
+
+def hist_snapshot():
+    """``{name: full to_dict()}`` for every live histogram — the
+    mergeable form the JSONL snapshot record and bench artifacts embed."""
+    with _lock:
+        return {name: h.to_dict() for name, h in _hists.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -306,9 +667,22 @@ def record_compile(fn, key):
     changed = _diff_keys(prev, key) or ["<cache key unchanged>"]
     event("recompile", fn, n=n, changed=changed)
     if n >= _RETRACE_WARN:
-        logging.warning(
-            "telemetry: %s compiled %d times (retrace); last change: %s",
-            fn, n, "; ".join(changed[:4]))
+        # warn once per (instance, cache-key family): ``fn`` keys are
+        # already instance-qualified (``serve.<name>.b<N>``,
+        # ``DataParallelStep[<id>]``), and the family is the SET of key
+        # leaves that moved — so two servers, or a server and a trainer
+        # in one process, never suppress each other's Nth-retrace
+        # warnings, while a hot loop retracing on the same axis warns
+        # exactly once instead of storming the log
+        family = tuple(sorted(c.split(":", 1)[0] for c in changed))
+        with _lock:
+            warned = (fn, family) in _retrace_warned
+            if not warned:
+                _retrace_warned.add((fn, family))
+        if not warned:
+            logging.warning(
+                "telemetry: %s compiled %d times (retrace); "
+                "last change: %s", fn, n, "; ".join(changed[:4]))
     return changed
 
 
@@ -438,6 +812,8 @@ def snapshot(events=64):
             "counters": dict(_counters),
             "gauges": dict(_gauges),
             "spans": spans,
+            "histograms": {name: h.summary()
+                           for name, h in _hists.items()},
             "compiles": {k: v["count"] for k, v in _compiles.items()},
             "events": list(_journal)[-events:] if events else [],
         }
@@ -451,6 +827,8 @@ def reset():
         _spans.clear()
         _journal.clear()
         _compiles.clear()
+        _retrace_warned.clear()
+        _hists.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -471,20 +849,23 @@ def set_jsonl_sink(path):
 
 
 def export_jsonl(path):
-    """One-shot dump: the journal plus a final ``snapshot`` record."""
+    """One-shot dump: the journal plus a final ``snapshot`` record.
+    The snapshot carries the FULL (mergeable) histogram dicts, not just
+    summaries, so ``telemetry_collect`` can sum them across ranks."""
     snap = snapshot(events=0)
+    hists = hist_snapshot()
     with _lock:
         events = list(_journal)
+    rec = {"ts": round(_WALL0 + _now(), 6), "kind": "snapshot",
+           "counters": snap["counters"], "gauges": snap["gauges"],
+           "spans": snap["spans"], "histograms": hists,
+           "compiles": snap["compiles"]}
+    if _rank is not None:
+        rec["rank"] = _rank
     with open(path, "w") as f:
-        for rec in events:
-            f.write(json.dumps(rec, default=str) + "\n")
-        f.write(json.dumps({"ts": round(_WALL0 + _now(), 6),
-                            "kind": "snapshot",
-                            "counters": snap["counters"],
-                            "gauges": snap["gauges"],
-                            "spans": snap["spans"],
-                            "compiles": snap["compiles"]},
-                           default=str) + "\n")
+        for r in events:
+            f.write(json.dumps(r, default=str) + "\n")
+        f.write(json.dumps(rec, default=str) + "\n")
     return path
 
 

@@ -1,0 +1,192 @@
+"""Cross-process trace collector: per-rank JSONL -> one timeline.
+
+``telemetry.export_jsonl`` gives each rank (or each process in a
+serve + trainer deployment) its own journal file; this module merges
+them into a single chrome://tracing JSON with one LANE PER RANK, so a
+PR-11 kill/re-form chaos run reads as one story: rank 2's journal stops,
+the survivors' ``elastic.detect`` / ``elastic.reshard`` /
+``elastic.resume`` spans line up on the shared clock, training resumes.
+
+Clock alignment: each export may carry a ``kind="clock"`` record
+(written by ``telemetry.sync_clock`` through the coordination KV store)
+pairing rank 0's published wall clock with the local one.  The per-file
+offset ``ref_wall - local_wall`` maps every local timestamp onto the
+reference timeline; files without a clock record merge at offset 0.
+
+Histograms merge too: the trailing ``snapshot`` record of each export
+carries full mergeable histogram dicts (same fixed log-bucket geometry
+everywhere), so cross-rank p50/p99 are exact bucket sums, not
+approximations of approximations.
+
+CLI::
+
+    python -m mxnet_tpu.telemetry_collect -o merged.trace.json \\
+        rank0.jsonl rank1.jsonl [--hist-out hist.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+from .telemetry import Histogram
+
+__all__ = ["load_jsonl", "merge", "merge_histograms",
+           "write_chrome_trace", "collect", "main"]
+
+
+def load_jsonl(path):
+    """Parse one export: list of record dicts (bad lines skipped — a
+    crash mid-write may tear the last line, and a torn tail must not
+    void the rest of the journal)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _rank_of(records, path, default):
+    """A file's lane: the ``rank`` stamped on its records, else digits
+    in the filename (``rank1.jsonl``), else its position in the input
+    list."""
+    for rec in records:
+        if "rank" in rec:
+            return int(rec["rank"])
+    m = re.search(r"(\d+)", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    return default
+
+
+def _offset_of(records):
+    """Seconds to ADD to this file's timestamps to land on the
+    reference (rank 0) timeline."""
+    for rec in records:
+        if rec.get("kind") == "clock" and rec.get("ref_wall") is not None \
+                and rec.get("local_wall") is not None:
+            return float(rec["ref_wall"]) - float(rec["local_wall"])
+    return 0.0
+
+
+def merge(paths):
+    """Merge exports into (chrome_events, merged_histograms, meta).
+
+    Chrome events use ``pid`` = rank (one lane per rank, named via
+    process_name metadata); spans keep their recording ``tid`` within
+    the lane and carry ``trace``/``sid``/``parent`` in ``args`` so a
+    request or a recovery can be followed across lanes."""
+    per_file = []
+    t0 = None
+    for i, path in enumerate(paths):
+        records = load_jsonl(path)
+        rank = _rank_of(records, path, i)
+        off = _offset_of(records)
+        per_file.append((path, rank, off, records))
+        for rec in records:
+            if "ts" in rec:
+                ts = float(rec["ts"]) + off
+                t0 = ts if t0 is None else min(t0, ts)
+    t0 = t0 or 0.0
+
+    events = []
+    ranks = []
+    for path, rank, off, records in per_file:
+        ranks.append(rank)
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": "rank %d (%s)"
+                                % (rank, os.path.basename(path))}})
+        for rec in records:
+            kind = rec.get("kind")
+            if "ts" not in rec or kind == "snapshot":
+                continue
+            ts_us = (float(rec["ts"]) + off - t0) * 1e6
+            args = {k: v for k, v in rec.items()
+                    if k not in ("ts", "kind", "name", "tid", "dur_ms")}
+            if kind == "span":
+                events.append({"name": rec.get("name", "span"),
+                               "ph": "X", "pid": rank,
+                               "tid": rec.get("tid", 0), "ts": ts_us,
+                               "dur": float(rec.get("dur_ms", 0)) * 1e3,
+                               "cat": "telemetry", "args": args})
+            else:
+                events.append({"name": "%s:%s" % (kind,
+                                                  rec.get("name", "")),
+                               "ph": "i", "s": "p", "pid": rank,
+                               "tid": rec.get("tid", 0), "ts": ts_us,
+                               "cat": "telemetry", "args": args})
+    hists = merge_histograms(r for _, _, _, recs in per_file
+                             for r in recs)
+    meta = {"ranks": sorted(set(ranks)), "files": len(per_file),
+            "events": len(events), "t0": t0}
+    return events, hists, meta
+
+
+def merge_histograms(records):
+    """Sum the histogram dicts out of every ``snapshot`` record — the
+    fixed shared bucket geometry makes cross-process quantiles exact
+    bucket arithmetic."""
+    merged = {}
+    for rec in records:
+        if rec.get("kind") != "snapshot":
+            continue
+        for name, d in (rec.get("histograms") or {}).items():
+            h = Histogram.from_dict(d)
+            if name in merged:
+                merged[name].merge(h)
+            else:
+                merged[name] = h
+    return merged
+
+
+def write_chrome_trace(path, events):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
+                  default=str)
+    return path
+
+
+def collect(paths, out, hist_out=None):
+    """Programmatic entry: merge ``paths`` -> chrome trace at ``out``
+    (plus merged histogram summaries at ``hist_out``).  Returns meta."""
+    events, hists, meta = merge(paths)
+    write_chrome_trace(out, events)
+    if hist_out:
+        with open(hist_out, "w") as f:
+            json.dump({name: {"summary": h.summary(),
+                              "hist": h.to_dict()}
+                       for name, h in hists.items()}, f, indent=1)
+    meta["histograms"] = sorted(hists)
+    return meta
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.telemetry_collect",
+        description="Merge per-rank telemetry JSONL exports into one "
+                    "chrome-trace timeline with per-rank lanes.")
+    ap.add_argument("inputs", nargs="+", help="per-rank .jsonl exports")
+    ap.add_argument("-o", "--out", required=True,
+                    help="merged chrome trace path")
+    ap.add_argument("--hist-out", default=None,
+                    help="merged histogram summaries (JSON)")
+    args = ap.parse_args(argv)
+    meta = collect(args.inputs, args.out, hist_out=args.hist_out)
+    print("telemetry_collect: %d file(s), ranks %s, %d events -> %s"
+          % (meta["files"], meta["ranks"], meta["events"], args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,19 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _incident_sandbox(tmp_path):
+    """The flight recorder is always-on (quarantine, watchdog, elastic
+    departure all dump bundles): route every test's bundles into its
+    tmp dir so the repo checkout never accumulates ``incidents/``, and
+    reset the per-process dump cap between tests."""
+    from mxnet_tpu import flight_recorder
+    flight_recorder.reset()
+    flight_recorder.configure(dir=str(tmp_path / "incidents"))
+    yield
+    flight_recorder.reset()
+
+
+@pytest.fixture(autouse=True)
 def _seed_rng():
     """Seeded reproducibility (reference tests/python/unittest/common.py:117
     @with_seed): default 42, overridable via MXNET_TEST_SEED — the knob

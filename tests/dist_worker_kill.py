@@ -122,12 +122,18 @@ def main_liveness():
 
 def main_elastic():
     import jax
-    from mxnet_tpu import kvstore, telemetry
+    from mxnet_tpu import flight_recorder, kvstore, telemetry
     from mxnet_tpu.parallel import chaos
     from mxnet_tpu.parallel.elastic import ElasticContext
 
     kv = kvstore.create("dist_sync")
     rank = kv.rank
+    # align this rank's journal onto rank 0's wall clock so the parent
+    # can merge every survivor's export into ONE de-skewed timeline
+    from jax._src import distributed as _dist
+    client = getattr(_dist.global_state, "client", None)
+    if client is not None:
+        telemetry.sync_clock(client, rank)
     chaos.install_from_env(rank=rank)
     step, batch = _build_step()
     ctx = ElasticContext(step, kvstore=kv,
@@ -159,6 +165,20 @@ def main_elastic():
     kinds = {(e["kind"], e["name"]) for e in events}
     assert ("elastic", "detect") in kinds
     assert ("elastic", "reshard") in kinds
+    spans = {e["name"] for e in events if e["kind"] == "span"}
+    assert {"elastic.detect", "elastic.reshard", "elastic.resume"} \
+        <= spans, spans
+    # the departure froze a flight-recorder bundle on this survivor
+    inc_base = flight_recorder.incident_dir()
+    bundles = [] if not os.path.isdir(inc_base) else \
+        [d for d in os.listdir(inc_base)
+         if d.startswith("incident-") and d.endswith("-elastic_departure")]
+    assert bundles, "survivor dumped no elastic_departure bundle"
+    # per-rank journal export for the parent's telemetry_collect merge
+    out_dir = os.environ.get("MXTPU_TELEMETRY_DIR")
+    if out_dir:
+        telemetry.export_jsonl(
+            os.path.join(out_dir, "telemetry-rank%d.jsonl" % rank))
     print("ELASTIC-WORKER %d OK (world %d->%d, loss %.4f->%.4f)"
           % (rank, detected["world_from"], detected["world_to"],
              losses[0], losses[-1]))

@@ -122,6 +122,26 @@ def test_hard_failures_gate_telemetry_overhead(bench):
     assert not bench._hard_failures([good])
 
 
+def test_hard_failures_require_live_instrumentation(bench):
+    """ISSUE 18: the 2% budget only counts if the ON leg PROVED trace
+    contexts + histograms were live — a 0% overhead from a dead
+    instrumentation path is itself a hard failure."""
+    live = {"bench": "telemetry_overhead", "overhead_pct": 0.4,
+            "overhead_ok": True, "telemetry_hist_count": 10,
+            "telemetry_traced": True}
+    assert not bench._hard_failures([live])
+    dead_hist = dict(live, telemetry_hist_count=0)
+    assert any("dead path" in h
+               for h in bench._hard_failures([dead_hist]))
+    untraced = dict(live, telemetry_traced=False)
+    assert any("dead path" in h
+               for h in bench._hard_failures([untraced]))
+    # pre-ISSUE-18 artifacts without the proof fields stay accepted
+    legacy = {"bench": "telemetry_overhead", "overhead_pct": 0.4,
+              "overhead_ok": True}
+    assert not bench._hard_failures([legacy])
+
+
 def test_hard_failures_gate_checkpoint_overhead(bench):
     """Async checkpointing's 2% overhead budget at the default cadence
     is a hard bench failure, mirroring the telemetry gate."""
@@ -207,3 +227,33 @@ def test_hard_failures_gate_serving_latency(bench):
     hung = dict(good, terminal_ok=False)
     hard = bench._hard_failures([hung])
     assert len(hard) == 1 and "terminal" in hard[0]
+
+
+def test_serving_latency_percentiles_come_from_histograms(bench):
+    """ISSUE 18: bench_serving_latency sources its per-leg p50/p99 from
+    the mergeable ``serve.request`` histogram (since-deltas per leg)
+    rather than a client-side sample list; the artifact carries the
+    provenance and the merged histogram itself, and the existing
+    p50/p99 gate keys keep working over histogram-derived values."""
+    from mxnet_tpu import telemetry
+
+    h = telemetry.Histogram()
+    for v in (3.0, 4.0, 4.5, 40.0):
+        h.add(v)
+    leg = {"rate_per_s": 25.0,
+           "p50_ms": round(h.quantile(0.50), 3),
+           "p99_ms": round(h.quantile(0.99), 3),
+           "hist": h.to_dict()}
+    art = {"bench": "serving_latency", "steady_state_recompiles": 0,
+           "recompile_ok": True, "latency_ok": True, "terminal_ok": True,
+           "latency_source": "histogram", "latency_hist": h.to_dict(),
+           "latency_hist_summary": h.summary(), "legs": [leg]}
+    assert bench._hard_failures([art]) == []
+    # quantiles from the log-bucketed histogram stay within bucket
+    # error of the exact samples, so the 10x-p50 gate math is sound
+    assert leg["p50_ms"] == pytest.approx(4.25, rel=0.15)
+    assert leg["p99_ms"] == pytest.approx(40.0, rel=0.15)
+    # a fat histogram-derived tail still fails through the same keys
+    fat = dict(art, latency_ok=False,
+               legs=[dict(leg, p99_ms=leg["p50_ms"] * 20)])
+    assert any("p99" in hh for hh in bench._hard_failures([fat]))

@@ -81,19 +81,68 @@ def test_elastic_chaos_kill_worker_mid_epoch(tmp_path):
     elastic/detect + elastic/reshard, and keep training with the loss
     still decreasing — no restart.  (The cross-extent ZeRO re-shard
     math itself is asserted bitwise in tests/test_elastic.py /
-    test_checkpoint.py, where a real multi-device dp mesh exists.)"""
+    test_checkpoint.py, where a real multi-device dp mesh exists.)
+
+    ISSUE 18 rides the same run: each survivor clock-syncs against
+    rank 0, exports its journal, and dumps an ``elastic_departure``
+    flight-recorder bundle; the parent merges the exports with
+    ``telemetry_collect`` and asserts ONE chrome trace showing the
+    detect -> reshard -> resume recovery on every survivor's lane."""
+    import json
+
+    tele_dir = str(tmp_path / "telemetry")
+    inc_dir = str(tmp_path / "incidents")
+    os.makedirs(tele_dir)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     env["MXTPU_KILL_MODE"] = "elastic"
     env["MXNET_TPU_CHAOS"] = "kill_worker:rank=2,at_step=3"
     env["MXNET_TPU_HEARTBEAT_TIMEOUT"] = "2"   # fast failure detection
+    env["MXTPU_TELEMETRY_DIR"] = tele_dir
+    env["MXNET_TPU_INCIDENT_DIR"] = inc_dir
     codes = launch.launch_local(
         3, [sys.executable, os.path.join(_REPO, "tests",
                                          "dist_worker_kill.py")], env=env)
     # survivors exit 0; the preempted rank exits with the fault's code
     assert codes[0] == 0 and codes[1] == 0, codes
     assert codes[2] == 1, codes
+
+    # collector-merged timeline: the dead rank never exported, the two
+    # survivors' files merge onto rank 0's reference clock
+    from mxnet_tpu import telemetry_collect
+    exports = sorted(os.path.join(tele_dir, f)
+                     for f in os.listdir(tele_dir))
+    assert len(exports) == 2, exports
+    merged = str(tmp_path / "merged.trace.json")
+    meta = telemetry_collect.collect(exports, merged)
+    assert meta["ranks"] == [0, 1]
+    trace = json.load(open(merged))
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    for r in (0, 1):
+        lane = {e["name"] for e in spans if e["pid"] == r}
+        assert {"elastic.detect", "elastic.reshard",
+                "elastic.resume"} <= lane, (r, lane)
+        # one causally-linked recovery per survivor: all three spans
+        # share the trace id opened by maybe_recover
+        ids = {e["args"].get("trace") for e in spans
+               if e["pid"] == r and e["name"].startswith("elastic.")}
+        assert len(ids) == 1 and None not in ids, (r, ids)
+
+    # each survivor froze a well-formed elastic_departure bundle
+    bundles = sorted(d for d in os.listdir(inc_dir)
+                     if d.endswith("-elastic_departure"))
+    seen_ranks = set()
+    for b in bundles:
+        files = sorted(os.listdir(os.path.join(inc_dir, b)))
+        assert files == ["config.json", "hbm.json", "histograms.json",
+                         "journal.jsonl", "lockgraph.json",
+                         "snapshot.json"], (b, files)
+        cfg = json.load(open(os.path.join(inc_dir, b, "config.json")))
+        assert cfg["reason"] == "elastic_departure"
+        assert "world 3 -> 2" in cfg["detail"]
+        seen_ranks.add(cfg["rank"])
+    assert seen_ranks == {0, 1}, seen_ranks
 
 
 @pytest.mark.slow

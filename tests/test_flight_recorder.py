@@ -1,0 +1,168 @@
+"""Crash flight recorder (ISSUE 18): always-on postmortem bundles.
+
+The bundle contract: ``dump_incident(reason)`` freezes the journal
+tail, histograms, counter/span snapshot, lock-order edges, HBM
+estimates and trigger config into one ``incident-<ts>-<reason>/``
+directory — built in a dot-tmp and published with ONE ``os.replace``
+(the ``incident_write_crash`` chaos fault fires inside exactly that
+window and must leave NO committed bundle and NO tmp litter).
+``dump_incident`` never raises: it runs on error paths.  Triggers
+across the stack (serve quarantine/watchdog, elastic departure,
+checkpoint write failure, numerics contract failure) are exercised in
+their own suites; this one owns the recorder's own contract.
+"""
+import json
+import os
+
+import pytest
+
+from mxnet_tpu import flight_recorder, telemetry
+from mxnet_tpu.parallel import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    telemetry.reset()
+    telemetry.enable()
+    chaos.clear()
+    # _incident_sandbox (conftest) already routes bundles to tmp_path
+    yield
+    chaos.clear()
+    telemetry.reset()
+
+
+def _populate():
+    telemetry.set_rank(1)
+    with telemetry.trace() as tr:
+        with telemetry.span("unit.step", hist=True):
+            pass
+        telemetry.event("serve", "outcome", outcome="timeout",
+                        reason="deadline")
+    telemetry.inc("unit.count", 2)
+    telemetry.event("lockorder", "edge", src="a", dst="b")
+    telemetry.set_rank(None)
+    return tr.trace_id
+
+
+def test_bundle_is_well_formed(tmp_path):
+    trace_id = _populate()
+    path = flight_recorder.dump_incident(
+        "unit_test", detail="synthetic", extra={"model": "m"})
+    assert path is not None and os.path.isdir(path)
+    assert os.path.basename(path).startswith("incident-")
+    assert os.path.basename(path).endswith("-unit_test")
+    names = sorted(os.listdir(path))
+    assert names == ["config.json", "hbm.json", "histograms.json",
+                     "journal.jsonl", "lockgraph.json", "snapshot.json"]
+    cfg = json.load(open(os.path.join(path, "config.json")))
+    assert cfg["reason"] == "unit_test"
+    assert cfg["detail"] == "synthetic"
+    assert cfg["extra"] == {"model": "m"}
+    assert cfg["pid"] == os.getpid()
+    snap = json.load(open(os.path.join(path, "snapshot.json")))
+    assert snap["counters"]["unit.count"] == 2
+    assert snap["spans"]["unit.step"]["count"] == 1
+    hists = json.load(open(os.path.join(path, "histograms.json")))
+    assert hists["unit.step"]["count"] == 1
+    lock = json.load(open(os.path.join(path, "lockgraph.json")))
+    assert any(e.get("src") == "a" and e.get("dst") == "b" for e in lock)
+    # the journal tail carries the trace — the postmortem can recover
+    # the affected request/step end to end
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(path, "journal.jsonl"))]
+    traced = [r for r in recs if r.get("trace") == trace_id]
+    assert any(r.get("kind") == "span" for r in traced)
+    assert any(r.get("name") == "outcome" for r in traced)
+    assert all(r.get("rank") == 1 for r in traced)
+    # success is journaled
+    evs = telemetry.snapshot()["events"]
+    assert any(e["kind"] == "incident" and e["name"] == "dumped"
+               and e["path"] == path for e in evs)
+
+
+def test_incident_write_crash_is_atomic():
+    """The chaos fault fires after the bundle is fully built but before
+    the one os.replace: no committed bundle, no tmp litter, the failure
+    journaled — and dump_incident does NOT raise (it runs on error
+    paths)."""
+    _populate()
+    base = flight_recorder.incident_dir()
+    chaos.install("incident_write_crash", times=1)
+    path = flight_recorder.dump_incident("crashy")
+    assert path is None
+    entries = os.listdir(base) if os.path.isdir(base) else []
+    assert not [e for e in entries if e.startswith("incident-")], entries
+    assert not [e for e in entries if e.startswith(".tmp-")], entries
+    evs = telemetry.snapshot()["events"]
+    assert any(e["kind"] == "incident" and e["name"] == "dump_failed"
+               and "incident_write_crash" in str(e.get("error"))
+               for e in evs)
+    assert flight_recorder.bundles_dumped() == 0
+    # next dump (fault exhausted) commits normally
+    path = flight_recorder.dump_incident("crashy")
+    assert path is not None and os.path.isdir(path)
+    assert flight_recorder.bundles_dumped() == 1
+
+
+def test_per_process_cap():
+    flight_recorder.configure(max_bundles=2)
+    assert flight_recorder.dump_incident("one") is not None
+    assert flight_recorder.dump_incident("two") is not None
+    assert flight_recorder.dump_incident("three") is None
+    assert flight_recorder.bundles_dumped() == 2
+    evs = telemetry.snapshot()["events"]
+    assert any(e["kind"] == "incident" and e["name"] == "skipped"
+               and e["reason"] == "three" for e in evs)
+
+
+def test_kill_switch(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_RECORDER", "0")
+    assert flight_recorder.dump_incident("off") is None
+    base = flight_recorder.incident_dir()
+    assert not (os.path.isdir(base) and os.listdir(base))
+
+
+def test_disabled_telemetry_means_no_bundle():
+    with telemetry.disabled():
+        assert flight_recorder.dump_incident("quiet") is None
+
+
+def test_numerics_contract_failure_dumps_bundle():
+    """A NumericsSanitizer contract violation freezes a bundle before
+    the AssertionError propagates."""
+    import numpy as onp
+    from tools.lint.runtime_numerics import NumericsSanitizer
+
+    san = NumericsSanitizer()
+    san.observe("grad:w", onp.array([1.0, onp.inf], "float32"), step=3)
+    with pytest.raises(AssertionError, match="non-finite"):
+        san.assert_all_finite()
+    base = flight_recorder.incident_dir()
+    bundles = [e for e in os.listdir(base)
+               if e.startswith("incident-")
+               and e.endswith("numerics_nonfinite")]
+    assert len(bundles) == 1
+    cfg = json.load(open(os.path.join(base, bundles[0], "config.json")))
+    assert "non-finite" in cfg["detail"]
+    # the journal tail holds the numerics/observed narration
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(base, bundles[0], "journal.jsonl"))]
+    assert any(r.get("kind") == "numerics" and r.get("nonfinite")
+               for r in recs)
+
+
+def test_parse_log_incident_summary(capsys):
+    """Satellite round-trip: tools/parse_log.py --incident renders a
+    committed bundle."""
+    import tools.parse_log as P
+
+    _populate()
+    telemetry.hist_observe("serve.request", 12.5)
+    path = flight_recorder.dump_incident("render_me", detail="d")
+    inc = P.parse_incident(path)
+    assert inc["config"]["reason"] == "render_me"
+    text = P.render_incident(inc)
+    assert "render_me" in text
+    assert "serve.request" in text
+    assert "traces: 1 distinct" in text
+    assert "serve/outcome" in text

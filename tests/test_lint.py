@@ -497,6 +497,30 @@ def test_changed_mode_matches_full_run(package_scan):
     assert elapsed < 12.0, "changed-mode run took %.1fs" % elapsed
 
 
+def test_changed_closure_covers_telemetry_collect():
+    """ISSUE 18 satellite: the cross-process collector and the flight
+    recorder ride the changed-mode closure — an edit to telemetry.py
+    (whose Histogram dict geometry both consume) must re-lint them —
+    and a changed-run over the collector itself stays clean."""
+    from tools.lint.core import collect_files, ModuleInfo
+    from tools.lint.jitgraph import PackageIndex
+    mods = []
+    for p in collect_files([os.path.join(REPO, "mxnet_tpu")]):
+        rel = os.path.relpath(p, REPO).replace(os.sep, "/")
+        mods.append(ModuleInfo(p, rel, open(p).read()))
+    idx = PackageIndex(mods)
+    closure = idx.reverse_dependency_closure({"mxnet_tpu/telemetry.py"})
+    assert "mxnet_tpu/telemetry_collect.py" in closure
+    assert "mxnet_tpu/flight_recorder.py" in closure
+    # and the collector passes the gate when IT is the changed file
+    target = "mxnet_tpu/telemetry_collect.py"
+    result = run_lint([os.path.join(REPO, "mxnet_tpu")],
+                      baseline_path=None, changed_files=[target])
+    assert target in result.files
+    bad = [f for f in result.new if f.path == target]
+    assert not bad, bad
+
+
 def test_reverse_dependency_closure(tmp_path):
     pkg = tmp_path / "pkg"
     pkg.mkdir()

@@ -202,6 +202,156 @@ def test_config_rejects_unbounded_queue():
         serve.ServeConfig(max_queue=-4)
 
 
+# -- ISSUE 18: incident bundles + traced timelines under chaos ---------------
+
+def _bundles(reason):
+    from mxnet_tpu import flight_recorder
+    base = flight_recorder.incident_dir()
+    if not os.path.isdir(base):
+        return []
+    return sorted(os.path.join(base, d) for d in os.listdir(base)
+                  if d.startswith("incident-")
+                  and d.endswith("-" + reason))
+
+
+def _load_journal(bundle):
+    import json
+    with open(os.path.join(bundle, "journal.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def test_poison_incident_bundle_recovers_the_story(package_lock_graph):
+    """The acceptance postmortem: a poisoned-executable chaos run must
+    leave ONE well-formed incident bundle from which the failing
+    bucket, the quarantine + DEGRADED transition, and the affected
+    requests' trace ids are all recoverable offline."""
+    import json
+    # the journal ring is process-global: start this postmortem from a
+    # clean slate so earlier chaos tests' evicted-half stories don't
+    # alias into the bundle
+    telemetry.reset()
+    kinds, srv, _ = _drive(
+        lambda s: chaos.install("executable_poison", bucket=4),
+        package_lock_graph, n=8, deadline_ms=800.0)
+    assert kinds["result"] == 8, kinds
+    bundles = _bundles("serve_quarantine")
+    assert len(bundles) == 1, bundles     # fresh quarantine dumps ONCE
+    b = bundles[0]
+    assert sorted(os.listdir(b)) == [
+        "config.json", "hbm.json", "histograms.json", "journal.jsonl",
+        "lockgraph.json", "snapshot.json"]
+    cfg = json.load(open(os.path.join(b, "config.json")))
+    assert cfg["reason"] == "serve_quarantine"
+    assert cfg["extra"]["bucket"] == 4
+    assert "bucket 4 quarantined" in cfg["detail"]
+    recs = _load_journal(b)
+    # the failing bucket + transition, straight from the journal tail
+    q = [r for r in recs if r.get("kind") == "serve"
+         and r.get("name") == "quarantine"]
+    assert q and q[-1]["bucket"] == 4
+    states = [r for r in recs if r.get("kind") == "serve"
+              and r.get("name") == "state"]
+    assert any(r["state_to"] == "DEGRADED" for r in states), states
+    # the affected requests: dispatch_error on bucket 4 names their
+    # trace ids, and each one maps back to a submitted request
+    errs = [r for r in recs if r.get("name") == "dispatch_error"
+            and r.get("bucket") == 4]
+    assert errs, [r.get("name") for r in recs]
+    affected = {t for r in errs for t in r["traces"]}
+    assert affected
+    submitted = {r["trace"] for r in recs if r.get("name") == "request"}
+    assert affected <= submitted
+    # ... and in the LIVE journal every affected trace still reached a
+    # terminal result on a fallback bucket (graceful degradation)
+    live = telemetry.snapshot(events=telemetry.JOURNAL_MAXLEN)["events"]
+    resolved = {r.get("trace") for r in live if r.get("name") == "outcome"
+                and r.get("outcome") == "result"}
+    assert affected <= resolved
+
+
+def test_watchdog_fire_dumps_incident(package_lock_graph):
+    import json
+    kinds, srv, wave2 = _drive(
+        lambda s: chaos.install("dispatch_stall", times=1, delay=0.4),
+        package_lock_graph, n=6, deadline_ms=400.0, second_wave=3)
+    bundles = _bundles("serve_watchdog")
+    assert bundles, "watchdog fired but no incident bundle"
+    cfg = json.load(open(os.path.join(bundles[0], "config.json")))
+    assert cfg["extra"]["respawned"] is True
+    assert cfg["extra"]["timed_out_requests"] >= 1
+    assert cfg["extra"]["traces"], cfg["extra"]
+    assert "dispatch stuck" in cfg["detail"]
+
+
+def test_respawn_exhaustion_dumps_incident(package_lock_graph):
+    import json
+    kinds, srv, wave2 = _drive(
+        lambda s: chaos.install("dispatch_stall", times=1, delay=0.4),
+        package_lock_graph, n=6, deadline_ms=300.0,
+        cfg=_cfg(max_respawns=0, dispatch_timeout_ms=60.0,
+                 batch_wait_ms=1.0, buckets=(1, 2)),
+        second_wave=3, wave2_delay=0.6)
+    bundles = _bundles("serve_respawn_exhausted")
+    assert bundles
+    cfg = json.load(open(os.path.join(bundles[0], "config.json")))
+    assert cfg["extra"]["respawned"] is False
+
+
+def test_graceful_degradation_modes_dump_no_incidents(
+        package_lock_graph):
+    """Backpressure sheds and deadline expiry are the system WORKING —
+    neither may burn an incident bundle (alert fatigue is how real
+    flight recorders get disabled)."""
+    from mxnet_tpu import flight_recorder
+    _drive(lambda s: chaos.install("request_burst", factor=32, times=1),
+           package_lock_graph, n=2, deadline_ms=600.0)
+    _drive(lambda s: chaos.install("deadline_storm", deadline_ms=0),
+           package_lock_graph, n=8)
+    base = flight_recorder.incident_dir()
+    dumped = [d for d in (os.listdir(base) if os.path.isdir(base)
+                          else []) if d.startswith("incident-")]
+    assert not dumped, dumped
+
+
+def test_chaos_run_exports_collector_mergeable_timeline(
+        tmp_path, package_lock_graph):
+    """Satellite: the journal a chaos run leaves behind merges into a
+    chrome-trace timeline (telemetry_collect) in which one request's
+    submit -> queue_wait -> dispatch -> outcome story is followable by
+    trace id, and the serve latency histograms ride along."""
+    import json
+    from mxnet_tpu import telemetry_collect
+    telemetry.reset()
+    kinds, srv, _ = _drive(
+        lambda s: chaos.install("executable_poison", bucket=4),
+        package_lock_graph, n=8, deadline_ms=800.0)
+    export = str(tmp_path / "serve0.jsonl")
+    telemetry.export_jsonl(export)
+    meta = telemetry_collect.collect(
+        [export], str(tmp_path / "merged.trace.json"),
+        hist_out=str(tmp_path / "hist.json"))
+    assert "serve.request" in meta["histograms"]
+    trace = json.load(open(str(tmp_path / "merged.trace.json")))
+    evs = trace["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    waits = [e for e in spans if e["name"] == "serve.queue_wait"
+             and e["args"].get("trace")]
+    assert waits
+    # follow ONE request end to end by its trace id
+    tid = waits[0]["args"]["trace"]
+    names = {e["name"] for e in evs
+             if (e.get("args") or {}).get("trace") == tid}
+    assert "serve.queue_wait" in names
+    assert "serve:request" in names and "serve:outcome" in names
+    # dispatch spans carry the whole part's traces
+    assert any(e["name"] == "serve.dispatch"
+               and tid in (e["args"].get("traces") or [])
+               for e in spans)
+    hists = json.load(open(str(tmp_path / "hist.json")))
+    assert hists["serve.request"]["summary"]["count"] >= kinds["result"]
+    assert hists["serve.queue_wait"]["hist"]["count"] >= kinds["result"]
+
+
 # -- graftlint registration -------------------------------------------------
 
 def test_serve_threads_in_lint_thread_entry_model():

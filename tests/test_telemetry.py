@@ -359,6 +359,211 @@ def test_export_chrome_trace(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# trace contexts (ISSUE 18): trace ids, sid/parent chains, rank stamps
+# ---------------------------------------------------------------------------
+
+def test_trace_ids_unique_and_pid_qualified():
+    import os
+    ids = {telemetry.new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all("%x" % (os.getpid() & 0xffffff) in i.split("-")[1]
+               for i in ids)
+
+
+def test_trace_context_stamps_events_and_spans():
+    with telemetry.trace() as tr:
+        assert telemetry.current_trace() == tr.trace_id
+        telemetry.event("unit", "inside")
+        with telemetry.span("unit.outer"):
+            with telemetry.span("unit.inner"):
+                pass
+    assert telemetry.current_trace() is None
+    telemetry.event("unit", "outside")
+    recs = telemetry.snapshot()["events"]
+    inside = [r for r in recs if r.get("name") == "inside"]
+    outside = [r for r in recs if r.get("name") == "outside"]
+    assert inside[0]["trace"] == tr.trace_id
+    assert "trace" not in outside[0]
+    spans = {r["name"]: r for r in recs if r["kind"] == "span"}
+    assert spans["unit.outer"]["trace"] == tr.trace_id
+    assert spans["unit.inner"]["trace"] == tr.trace_id
+    # the sid/parent chain links inner -> outer causally
+    assert spans["unit.inner"]["parent"] == spans["unit.outer"]["sid"]
+    assert spans["unit.outer"].get("parent") is None
+
+
+def test_trace_join_if_active_vs_explicit_reenter():
+    with telemetry.trace() as outer:
+        # no id + active trace: JOIN (same id, and exit must not
+        # tear down the outer context)
+        with telemetry.trace() as joined:
+            assert joined.trace_id == outer.trace_id
+        assert telemetry.current_trace() == outer.trace_id
+    # explicit id always activates (the serve worker-thread re-enter)
+    with telemetry.trace("req-42") as tr:
+        assert tr.trace_id == "req-42"
+        telemetry.event("unit", "reentered")
+    recs = telemetry.snapshot()["events"]
+    assert any(r.get("trace") == "req-42" for r in recs
+               if r.get("name") == "reentered")
+
+
+def test_rank_stamped_on_every_record():
+    telemetry.set_rank(3)
+    try:
+        telemetry.event("unit", "ranked")
+        with telemetry.span("unit.r"):
+            pass
+    finally:
+        telemetry.set_rank(None)
+    recs = telemetry.snapshot()["events"]
+    assert all(r.get("rank") == 3 for r in recs
+               if r.get("name") in ("ranked", "unit.r"))
+
+
+def test_span_event_carries_explicit_trace_and_histogram():
+    telemetry.span_event("unit.cross", 0.005, trace="t-1",
+                         parent=7, hist=True, bucket=4)
+    recs = telemetry.snapshot()["events"]
+    rec = [r for r in recs if r.get("name") == "unit.cross"][0]
+    assert rec["trace"] == "t-1" and rec["parent"] == 7
+    assert rec["bucket"] == 4
+    assert telemetry.snapshot()["spans"]["unit.cross"]["count"] == 1
+    assert telemetry.histogram("unit.cross").count == 1
+
+
+# ---------------------------------------------------------------------------
+# online histograms: log-bucketed, fixed memory, mergeable
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_track_exact_within_bucket_error():
+    import math
+    rs = onp.random.RandomState(7)
+    samples = onp.exp(rs.randn(5000) * 1.5 + 1.0)   # lognormal ms
+    h = telemetry.Histogram()
+    for v in samples:
+        h.add(float(v))
+    s = onp.sort(samples)
+    for q in (0.5, 0.9, 0.99):
+        exact = float(s[int(q * len(s)) - 1])
+        est = h.quantile(q)
+        # bucket ratio is 10**(1/10) ~ 1.26; allow 2 bucket widths
+        assert abs(math.log10(est) - math.log10(exact)) < 0.2, \
+            (q, est, exact)
+    assert h.min == float(samples.min())
+    assert h.max == float(samples.max())
+
+
+def test_histogram_memory_is_fixed():
+    h = telemetry.Histogram()
+    h.add(1.0)
+    n_after_10 = len(h.buckets)
+    for v in range(10000):
+        h.add(float(v) + 0.5)
+    assert len(h.buckets) == n_after_10 == telemetry.Histogram.NBUCKETS
+    assert h.count == 10001
+
+
+def test_histogram_merge_and_roundtrip():
+    a, b = telemetry.Histogram(), telemetry.Histogram()
+    for v in (1.0, 2.0, 3.0):
+        a.add(v)
+    for v in (100.0, 200.0):
+        b.add(v)
+    merged = telemetry.Histogram.from_dict(a.to_dict()).merge(b)
+    assert merged.count == 5
+    assert merged.min == 1.0 and merged.max == 200.0
+    assert merged.quantile(0.5) < 100.0 <= merged.quantile(0.95)
+    # geometry mismatch is a loud error, not silent bucket garbage
+    bad = a.to_dict()
+    bad["bpd"] = 5
+    with pytest.raises(ValueError):
+        telemetry.Histogram.from_dict(bad)
+
+
+def test_histogram_since_carves_a_leg():
+    h = telemetry.Histogram()
+    for v in (1.0, 2.0, 4.0):
+        h.add(v)
+    base = h.to_dict()
+    for v in (50.0, 60.0, 70.0, 80.0):
+        h.add(v)
+    leg = h.since(base)
+    assert leg.count == 4
+    assert 40.0 < leg.quantile(0.5) < 100.0
+
+
+def test_span_hist_feeds_named_histogram():
+    with telemetry.span("unit.h", hist=True):
+        pass
+    with telemetry.span("unit.h", hist=True):
+        pass
+    h = telemetry.histogram("unit.h")
+    assert h is not None and h.count == 2
+    snap = telemetry.snapshot()
+    assert snap["histograms"]["unit.h"]["count"] == 2
+
+
+def test_export_jsonl_snapshot_carries_histograms(tmp_path):
+    telemetry.hist_observe("exp.h", 5.0)
+    dump = tmp_path / "dump.jsonl"
+    telemetry.export_jsonl(str(dump))
+    recs = [json.loads(ln) for ln in
+            dump.read_text().strip().splitlines()]
+    snap_rec = [r for r in recs if r["kind"] == "snapshot"][0]
+    assert snap_rec["histograms"]["exp.h"]["count"] == 1
+    # full mergeable form, not just the summary
+    assert "buckets" in snap_rec["histograms"]["exp.h"]
+
+
+# ---------------------------------------------------------------------------
+# retrace-warning dedupe: one warning per (instance, changed-key family)
+# ---------------------------------------------------------------------------
+
+def test_retrace_warning_dedupes_per_key_family(caplog):
+    with caplog.at_level(logging.WARNING):
+        for n in (2, 4, 8, 16):
+            telemetry.record_compile("fam.fn", {"shape": [2, n]})
+    warns = [r for r in caplog.records if "retrace" in r.message
+             and "fam.fn" in r.message]
+    assert len(warns) == 1, [r.message for r in warns]
+    # a DIFFERENT changed-key family on the same instance warns again
+    with caplog.at_level(logging.WARNING):
+        telemetry.record_compile("fam.fn", {"shape": [2, 16],
+                                            "dtype": "bf16"})
+    warns = [r for r in caplog.records if "retrace" in r.message
+             and "fam.fn" in r.message]
+    assert len(warns) == 2, [r.message for r in warns]
+    # every retrace still journals an event (dedupe is log-side only)
+    evs = [e for e in telemetry.snapshot()["events"]
+           if e["kind"] == "recompile"]
+    assert len(evs) == 4
+
+
+def test_sync_clock_journals_reference_pair():
+    class FakeKV:
+        def __init__(self):
+            self.kv = {}
+
+        def key_value_set(self, k, v):
+            self.kv[k] = v
+
+        def blocking_key_value_get(self, k, timeout_ms):
+            return self.kv[k]
+
+    kv = FakeKV()
+    ref0 = telemetry.sync_clock(kv, 0, key="t/clock")
+    ref1 = telemetry.sync_clock(kv, 1, key="t/clock")
+    assert ref0 is not None and abs(ref1 - ref0) < 1e-6
+    clocks = [e for e in telemetry.snapshot()["events"]
+              if e["kind"] == "clock"]
+    assert len(clocks) == 2
+    for e in clocks:
+        assert e["local_wall"] is not None
+        assert e["ref_wall"] is not None
+
+
+# ---------------------------------------------------------------------------
 # attention dispatch census
 # ---------------------------------------------------------------------------
 

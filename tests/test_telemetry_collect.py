@@ -1,0 +1,164 @@
+"""Cross-process trace collector (ISSUE 18):
+``mxnet_tpu.telemetry_collect`` merges per-rank JSONL exports into one
+chrome-trace timeline — one lane per rank, clock-skew de-skewed via the
+``sync_clock`` reference pair, histograms summed bucket-wise.
+"""
+import json
+
+import pytest
+
+from mxnet_tpu import telemetry, telemetry_collect
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.set_jsonl_sink(None)
+    telemetry.reset()
+
+
+def _write_export(path, rank, clock_skew_s=0.0, ref_wall=1000.0,
+                  events=(), hist=None):
+    """Hand-author one rank's export: a clock record pairing the shared
+    reference with a skewed local wall, then events stamped on the
+    SKEWED local clock, then the trailing snapshot record."""
+    recs = [{"ts": ref_wall + clock_skew_s, "kind": "clock",
+             "name": "sync", "rank": rank,
+             "local_wall": ref_wall + clock_skew_s,
+             "ref_wall": ref_wall}]
+    for off_s, kind, name, extra in events:
+        rec = {"ts": ref_wall + clock_skew_s + off_s, "kind": kind,
+               "name": name, "rank": rank}
+        rec.update(extra)
+        recs.append(rec)
+    snap = {"ts": ref_wall + clock_skew_s + 99.0, "kind": "snapshot",
+            "rank": rank, "counters": {}, "gauges": {}, "spans": {},
+            "histograms": hist or {}}
+    recs.append(snap)
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def _hist_dict(*values):
+    h = telemetry.Histogram()
+    for v in values:
+        h.add(v)
+    return h.to_dict()
+
+
+def test_merge_deskews_ranks_onto_reference_clock(tmp_path):
+    """Rank 1's clock runs 5s behind; an event it stamps locally at
+    +2.0 really happened at reference +2.0 and must land AFTER rank 0's
+    +1.0 event in the merged timeline."""
+    p0 = _write_export(
+        str(tmp_path / "rank0.jsonl"), 0, clock_skew_s=0.0,
+        events=[(1.0, "span", "elastic.detect",
+                 {"dur_ms": 3.0, "trace": "t-a"})])
+    p1 = _write_export(
+        str(tmp_path / "rank1.jsonl"), 1, clock_skew_s=-5.0,
+        events=[(2.0, "span", "elastic.reshard",
+                 {"dur_ms": 7.0, "trace": "t-a", "sid": 4})])
+    events, hists, meta = telemetry_collect.merge([p0, p1])
+    assert meta["ranks"] == [0, 1]
+    lanes = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert any("rank 0" in n for n in lanes)
+    assert any("rank 1" in n for n in lanes)
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    detect, reshard = spans["elastic.detect"], spans["elastic.reshard"]
+    assert detect["pid"] == 0 and reshard["pid"] == 1
+    # de-skew: despite rank 1's local stamps being 3s EARLIER than
+    # rank 0's, reference ordering puts reshard after detect
+    assert reshard["ts"] > detect["ts"]
+    assert abs((reshard["ts"] - detect["ts"]) - 1.0e6) < 1.0
+    # trace linkage rides in args across lanes
+    assert detect["args"]["trace"] == reshard["args"]["trace"] == "t-a"
+    assert reshard["args"]["sid"] == 4
+
+
+def test_merge_without_clock_record_defaults_to_zero_offset(tmp_path):
+    p = str(tmp_path / "solo7.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"ts": 5.0, "kind": "span", "name": "s",
+                            "dur_ms": 1.0}) + "\n")
+        f.write("{torn json\n")   # torn tail must not void the file
+    events, _, meta = telemetry_collect.merge([p])
+    # no rank stamp: lane comes from the filename digits
+    assert meta["ranks"] == [7]
+    assert [e for e in events if e["ph"] == "X"]
+
+
+def test_merge_histograms_is_exact_bucket_arithmetic(tmp_path):
+    p0 = _write_export(str(tmp_path / "rank0.jsonl"), 0,
+                       hist={"serve.request": _hist_dict(1.0, 2.0)})
+    p1 = _write_export(str(tmp_path / "rank1.jsonl"), 1,
+                       hist={"serve.request": _hist_dict(100.0),
+                             "trainer.step": _hist_dict(5.0)})
+    _, hists, _ = telemetry_collect.merge([p0, p1])
+    assert hists["serve.request"].count == 3
+    assert hists["serve.request"].min == 1.0
+    assert hists["serve.request"].max == 100.0
+    assert hists["trainer.step"].count == 1
+    # identical to feeding one histogram directly: merge is exact
+    direct = telemetry.Histogram()
+    for v in (1.0, 2.0, 100.0):
+        direct.add(v)
+    assert hists["serve.request"].buckets == direct.buckets
+
+
+def test_cli_end_to_end_from_real_exports(tmp_path):
+    """Round-trip with REAL telemetry exports (not hand-authored):
+    two processes' worth of journal state, merged via main()."""
+    exports = []
+    for rank in (0, 1):
+        telemetry.reset()
+        telemetry.set_rank(rank)
+        with telemetry.trace("t-shared"):
+            with telemetry.span("trainer.step", hist=True):
+                pass
+        telemetry.hist_observe("serve.request", 10.0 * (rank + 1))
+        out = str(tmp_path / ("rank%d.jsonl" % rank))
+        telemetry.export_jsonl(out)
+        exports.append(out)
+    telemetry.set_rank(None)
+    trace_out = str(tmp_path / "merged.trace.json")
+    hist_out = str(tmp_path / "hist.json")
+    rc = telemetry_collect.main(
+        exports + ["-o", trace_out, "--hist-out", hist_out])
+    assert rc == 0
+    trace = json.load(open(trace_out))
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    assert all(e["args"]["trace"] == "t-shared" for e in spans
+               if e["name"] == "trainer.step")
+    hists = json.load(open(hist_out))
+    assert hists["serve.request"]["summary"]["count"] == 2
+    assert hists["trainer.step"]["hist"]["count"] == 2
+
+
+def test_collector_output_renders_in_parse_log(tmp_path):
+    """Satellite round-trip: a merged multi-rank export (concatenated
+    JSONL) renders trace waterfalls and merged histogram quantiles in
+    tools/parse_log.py."""
+    import tools.parse_log as P
+
+    merged = str(tmp_path / "merged.jsonl")
+    with open(merged, "w") as f:
+        for rank in (0, 1):
+            p = _write_export(
+                str(tmp_path / ("r%d.jsonl" % rank)), rank,
+                clock_skew_s=-2.0 * rank,
+                events=[(1.0 + rank, "span", "elastic.resume",
+                         {"dur_ms": 2.0, "trace": "t-m", "sid": rank + 1})],
+                hist={"trainer.step": _hist_dict(4.0, 8.0)})
+            f.write(open(p).read())
+    agg = P.parse_jsonl(open(merged))
+    assert agg["histograms"]["trainer.step"]["count"] == 4
+    assert set(agg["traces"]) == {"t-m"}
+    text = P.render_trace(agg, "t-m")
+    assert text.count("elastic.resume") == 2
+    summary = P.render_jsonl(agg)
+    assert "trainer.step" in summary and "p99-ms" in summary

@@ -187,34 +187,53 @@ class NumericsSanitizer:
         return {s: r["dtypes"][0] for s, r in self.observed.items()
                 if r["dtypes"]}
 
+    @staticmethod
+    def _contract_failed(contract: str, msg: str):
+        """A violated runtime contract is an incident: freeze the
+        flight-recorder bundle (journal tail holds the
+        ``numerics/observed`` events that narrate the drift) BEFORE
+        raising, so the postmortem survives the test/process dying on
+        the AssertionError."""
+        try:
+            from mxnet_tpu import flight_recorder
+            flight_recorder.dump_incident("numerics_%s" % contract,
+                                          detail=msg)
+        except Exception:       # recorder trouble must not mask the bug
+            pass
+        raise AssertionError(msg)
+
     def assert_all_finite(self):
         bad = {s: r["nonfinite"] for s, r in self.observed.items()
                if r["nonfinite"]}
-        assert not bad, (
-            "runtime numerics: non-finite values observed (first at "
-            "step %s in %r):\n  " % (self.first_nonfinite or (None, "?"))
-            + "\n  ".join("%s: %d non-finite" % kv
-                          for kv in sorted(bad.items())))
+        if bad:
+            self._contract_failed("nonfinite", (
+                "runtime numerics: non-finite values observed (first at "
+                "step %s in %r):\n  "
+                % (self.first_nonfinite or (None, "?"))
+                + "\n  ".join("%s: %d non-finite" % kv
+                              for kv in sorted(bad.items()))))
 
     def assert_no_dtype_drift(self):
         drifted = {s: r["dtypes"] for s, r in self.observed.items()
                    if len(r["dtypes"]) > 1}
-        assert not drifted, (
-            "runtime numerics: observed dtype drift (a live implicit "
-            "promotion — the static complement is "
-            "num-implicit-promotion):\n  "
-            + "\n  ".join("%s: %s" % (s, " -> ".join(d))
-                          for s, d in sorted(drifted.items())))
+        if drifted:
+            self._contract_failed("dtype_drift", (
+                "runtime numerics: observed dtype drift (a live "
+                "implicit promotion — the static complement is "
+                "num-implicit-promotion):\n  "
+                + "\n  ".join("%s: %s" % (s, " -> ".join(d))
+                              for s, d in sorted(drifted.items()))))
 
     def assert_master_fp32(self):
         bad = {s: r["dtypes"] for s, r in self.observed.items()
                if r.get("role") == "master"
                and r["dtypes"] != ["float32"]}
-        assert not bad, (
-            "runtime numerics: fp32 master leaves observed off-float32 "
-            "(num-master-dtype contract):\n  "
-            + "\n  ".join("%s: %s" % (s, d)
-                          for s, d in sorted(bad.items())))
+        if bad:
+            self._contract_failed("master_dtype", (
+                "runtime numerics: fp32 master leaves observed "
+                "off-float32 (num-master-dtype contract):\n  "
+                + "\n  ".join("%s: %s" % (s, d)
+                              for s, d in sorted(bad.items()))))
 
     def assert_consistent_with(self, flow: dict):
         """Every observed site named ``"<relpath>:<qualname>:<var>"``
@@ -229,9 +248,10 @@ class NumericsSanitizer:
                 continue
             if rec["dtypes"] != [expect]:
                 mismatches.append((site, expect, rec["dtypes"]))
-        assert not mismatches, (
-            "runtime numerics: observed dtypes diverge from the static "
-            "dtype-flow table (unmodeled conversion or analyzer "
-            "regression):\n  "
-            + "\n  ".join("%s: static %s, observed %s" % m
-                          for m in mismatches))
+        if mismatches:
+            self._contract_failed("flow_mismatch", (
+                "runtime numerics: observed dtypes diverge from the "
+                "static dtype-flow table (unmodeled conversion or "
+                "analyzer regression):\n  "
+                + "\n  ".join("%s: static %s, observed %s" % m
+                              for m in mismatches)))

@@ -16,6 +16,16 @@ and (``--jsonl``) the telemetry JSONL metrics sink
 (``mxnet_tpu.telemetry.export_jsonl`` / ``set_jsonl_sink``), and prints
 markdown (or tsv) with one row per epoch.
 
+``--jsonl --trace <id>`` renders ONE trace as a waterfall table: every
+span/event carrying that trace id, ordered by timestamp, nested by the
+``sid``/``parent`` chain — one serve request or one training step end
+to end, across ranks when the input is a collector-merged export.
+
+``--incident <dir>`` summarises a flight-recorder bundle
+(``mxnet_tpu.flight_recorder.dump_incident``): the trigger, the
+journal-tail census, histogram quantiles and counters at the moment of
+death.
+
 ``--lint`` renders a graftlint JSON findings report
 (``python -m tools.lint --format json``) as a per-rule/per-file table
 plus the individual new findings — the human-readable face of the lint
@@ -23,6 +33,8 @@ gate's machine output.
 """
 import argparse
 import json
+import math
+import os
 import re
 import sys
 
@@ -69,14 +81,69 @@ def parse(lines):
     return rows
 
 
+def _hist_merge(into, d):
+    """Merge one ``Histogram.to_dict`` snapshot into ``into`` (same
+    sparse-bucket form) — how multi-rank snapshot records in one
+    collector-merged file combine.  Pure dict math: this script stays
+    import-free of mxnet_tpu, and the geometry (``lo``/``bpd``) rides
+    in the snapshot itself."""
+    if into is None:
+        return dict(d, buckets=dict(d.get("buckets") or {}))
+    into["count"] = into.get("count", 0) + d.get("count", 0)
+    into["sum"] = into.get("sum", 0.0) + d.get("sum", 0.0)
+    for k in ("min", "max"):
+        pick = min if k == "min" else max
+        vs = [v for v in (into.get(k), d.get(k)) if v is not None]
+        into[k] = pick(vs) if vs else None
+    b = into.setdefault("buckets", {})
+    for i, c in (d.get("buckets") or {}).items():
+        b[i] = b.get(i, 0) + c
+    return into
+
+
+def _hist_quantile(d, q):
+    """Quantile from a ``Histogram.to_dict`` snapshot: geometric
+    midpoint of the bucket holding the q-th observation, clamped by the
+    exact min/max (mirrors mxnet_tpu.telemetry.Histogram.quantile)."""
+    count = d.get("count", 0)
+    if not count:
+        return None
+    lo = float(d.get("lo", 1e-3))
+    bpd = float(d.get("bpd", 10))
+
+    def edge(j):
+        return lo * 10.0 ** (j / bpd)
+
+    target = q * count
+    seen = 0
+    for i, c in sorted((int(k), v)
+                       for k, v in (d.get("buckets") or {}).items()):
+        seen += c
+        if seen >= target and c:
+            b_lo = 0.0 if i == 0 else edge(i - 1)
+            b_hi = edge(i)
+            mid = math.sqrt(b_lo * b_hi) if b_lo > 0 else b_hi / 2.0
+            if d.get("min") is not None:
+                mid = max(d["min"], mid)
+            if d.get("max") is not None:
+                mid = min(d["max"], mid)
+            return mid
+    return d.get("max")
+
+
 def parse_jsonl(lines):
     """Parse a telemetry JSONL sink (one JSON object per line) into
     ``{"spans": {name: {count, mean_ms, total_ms}}, "counters": {...},
-    "gauges": {...}, "recompiles": [...], "steps": int}``.
+    "gauges": {...}, "recompiles": [...], "steps": int}`` plus the
+    observability streams: ``histograms`` (name -> merged
+    ``Histogram.to_dict``), ``traces`` (trace id -> its records, in
+    file order) and ``incidents`` (flight-recorder dump journal).
 
     Span stats are aggregated from the per-event ``dur_ms`` stream; a
     trailing ``kind="snapshot"`` record (written by ``export_jsonl``)
-    overrides counters/gauges with the authoritative final values."""
+    overrides counters/gauges with the authoritative final values —
+    histogram snapshots from SEVERAL ranks' records merge by adding
+    counts."""
     spans = {}
     counters = {}
     gauges = {}
@@ -85,6 +152,9 @@ def parse_jsonl(lines):
     lockorder = []
     numerics = {}
     autotune = []
+    histograms = {}
+    traces = {}
+    incidents = []
     model = {"errors": [], "fallbacks": {}, "picks": 0}
     program = []
     elastic = []
@@ -101,10 +171,19 @@ def parse_jsonl(lines):
         except ValueError:
             continue
         kind = rec.get("kind")
+        if rec.get("trace") is not None:
+            traces.setdefault(str(rec["trace"]), []).append(rec)
         if kind == "span":
             s = spans.setdefault(rec["name"], {"count": 0, "total_ms": 0.0})
             s["count"] += 1
             s["total_ms"] += float(rec.get("dur_ms", 0.0))
+        elif kind == "incident":
+            # flight-recorder dump journal: one row per committed /
+            # capped / failed bundle (mxnet_tpu.flight_recorder)
+            incidents.append({"event": rec.get("name"),
+                              "reason": rec.get("reason"),
+                              "path": rec.get("path"),
+                              "error": rec.get("error")})
         elif kind == "step":
             steps += 1
         elif kind == "recompile":
@@ -243,6 +322,8 @@ def parse_jsonl(lines):
             for name, agg in rec.get("spans", {}).items():
                 spans[name] = {"count": agg["count"],
                                "total_ms": agg["total_ms"]}
+            for name, h in (rec.get("histograms") or {}).items():
+                histograms[name] = _hist_merge(histograms.get(name), h)
     for s in spans.values():
         s["mean_ms"] = round(s["total_ms"] / s["count"], 4) \
             if s["count"] else None
@@ -251,7 +332,9 @@ def parse_jsonl(lines):
             "recompiles": recompiles, "steps": steps, "hbm": hbm,
             "lockorder": lockorder, "numerics": numerics,
             "autotune": autotune, "model": model, "program": program,
-            "elastic": elastic, "serve": serve, "lint_gate": lint_gate}
+            "elastic": elastic, "serve": serve, "lint_gate": lint_gate,
+            "histograms": histograms, "traces": traces,
+            "incidents": incidents}
 
 
 def _render_hbm(hbm, fmt="markdown"):
@@ -322,7 +405,175 @@ def render_jsonl(agg, fmt="markdown"):
     out.extend(_render_elastic(agg.get("elastic") or [], fmt))
     out.extend(_render_serve(agg.get("serve") or {},
                              agg.get("counters") or {}, fmt))
+    out.extend(_render_histograms(agg.get("histograms") or {}, fmt))
+    out.extend(_render_traces(agg.get("traces") or {}))
+    out.extend(_render_incidents(agg.get("incidents") or [], fmt))
     out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
+    return "\n".join(out)
+
+
+def _render_histograms(histograms, fmt="markdown"):
+    """Quantile digest table from the snapshot records' mergeable
+    histogram dicts — one row per metric (serve latency, queue wait,
+    step time, prefetch stages), quantiles computed bucket-side."""
+    if not histograms:
+        return []
+    header = ["histogram", "count", "mean-ms", "p50-ms", "p90-ms",
+              "p99-ms", "max-ms"]
+    out = ["", "histograms (log-bucketed, merged across snapshots):"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+
+    def g(v):
+        return "%.6g" % v if v is not None else "-"
+
+    for name in sorted(histograms):
+        h = histograms[name]
+        n = h.get("count", 0)
+        vals = [name, str(n),
+                g(h.get("sum", 0.0) / n if n else None),
+                g(_hist_quantile(h, 0.50)), g(_hist_quantile(h, 0.90)),
+                g(_hist_quantile(h, 0.99)), g(h.get("max"))]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
+
+
+def _render_traces(traces):
+    """One summary line: how many distinct traces the journal carries
+    (render any single one with ``--trace <id>``)."""
+    if not traces:
+        return []
+    ids = sorted(traces, key=lambda t: traces[t][0].get("ts") or 0)
+    shown = ", ".join(ids[:4]) + (", ..." if len(ids) > 4 else "")
+    return ["", "traces: %d distinct (%s) — render one with "
+            "--trace <id>" % (len(ids), shown)]
+
+
+def _render_incidents(incidents, fmt="markdown"):
+    """Flight-recorder dump journal: one row per committed, capped or
+    failed bundle."""
+    if not incidents:
+        return []
+    header = ["incident", "reason", "path/error"]
+    out = ["", "flight-recorder incidents:"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    for e in incidents:
+        vals = [str(e.get("event", "?")), str(e.get("reason", "?")),
+                str(e.get("path") or e.get("error") or "-")]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
+
+
+def render_trace(agg, trace_id, fmt="markdown"):
+    """Waterfall for ONE trace: its records ordered by timestamp,
+    span names indented by the ``sid``/``parent`` nesting, offsets
+    relative to the trace's first record — readable straight off a
+    per-rank export or a collector-merged multi-rank file."""
+    recs = (agg.get("traces") or {}).get(str(trace_id))
+    if not recs:
+        return "trace %s: not found (%d traces in input)" \
+            % (trace_id, len(agg.get("traces") or {}))
+    recs = sorted(recs, key=lambda r: r.get("ts") or 0)
+    t0 = recs[0].get("ts") or 0
+    depth = {}
+    for r in recs:
+        sid = r.get("sid")
+        if sid is not None:
+            depth[sid] = depth.get(r.get("parent"), 0) + 1
+    header = ["offset-ms", "dur-ms", "rank", "kind", "name", "detail"]
+    out = ["trace %s (%d records):" % (trace_id, len(recs))]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    skip = ("ts", "kind", "name", "trace", "sid", "parent", "tid",
+            "dur_ms", "rank")
+    for r in recs:
+        ind = "  " * (depth.get(r.get("sid"),
+                                depth.get(r.get("parent"), 0)))
+        detail = " ".join(
+            "%s=%s" % (k, r[k]) for k in sorted(r)
+            if k not in skip and r[k] is not None)
+        vals = ["%.3f" % (((r.get("ts") or 0) - t0) * 1e3),
+                "%.3f" % r["dur_ms"] if r.get("dur_ms") is not None
+                else "-",
+                "-" if r.get("rank") is None else str(r["rank"]),
+                str(r.get("kind", "?")),
+                ind + str(r.get("name", "?")), detail or "-"]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return "\n".join(out)
+
+
+def parse_incident(path):
+    """Load a flight-recorder bundle directory
+    (``incident-<ts>-<reason>/``) into one dict: config + snapshot +
+    histogram dicts + the parsed journal tail."""
+    def load(name, default):
+        p = os.path.join(path, name)
+        if not os.path.exists(p):
+            return default
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except ValueError:
+            return default
+
+    journal_path = os.path.join(path, "journal.jsonl")
+    journal_agg = None
+    n_journal = 0
+    if os.path.exists(journal_path):
+        with open(journal_path) as f:
+            lines = f.readlines()
+        n_journal = len(lines)
+        journal_agg = parse_jsonl(lines)
+    return {"path": path, "config": load("config.json", {}),
+            "snapshot": load("snapshot.json", {}),
+            "histograms": load("histograms.json", {}),
+            "lockgraph": load("lockgraph.json", []),
+            "hbm": load("hbm.json", []),
+            "journal": journal_agg, "journal_records": n_journal}
+
+
+def render_incident(inc, fmt="markdown"):
+    """Bundle summary: the trigger line (reason/detail/rank/pid), the
+    journal-tail census (event kinds, serve outcomes, traces),
+    histogram quantiles and final counters."""
+    cfg = inc.get("config") or {}
+    out = ["incident bundle %s" % inc.get("path"),
+           "  reason: %s" % cfg.get("reason"),
+           "  detail: %s" % cfg.get("detail"),
+           "  rank=%s pid=%s ts=%s" % (cfg.get("rank"), cfg.get("pid"),
+                                       cfg.get("ts"))]
+    if cfg.get("extra"):
+        out.append("  extra: %s" % json.dumps(cfg["extra"],
+                                              default=str,
+                                              sort_keys=True))
+    snap = inc.get("snapshot") or {}
+    counters = snap.get("counters") or {}
+    if counters:
+        out.append("  counters: %s"
+                   % " ".join("%s=%s" % (k, counters[k])
+                              for k in sorted(counters)))
+    out.extend(_render_histograms(inc.get("histograms") or {}, fmt))
+    j = inc.get("journal")
+    if j is not None:
+        out.append("")
+        out.append("journal tail (%d records):"
+                   % inc.get("journal_records", 0))
+        out.append("  traces: %d distinct"
+                   % len(j.get("traces") or {}))
+        out.extend(_render_serve(j.get("serve") or {},
+                                 j.get("counters") or {}, fmt))
+        out.extend(_render_elastic(j.get("elastic") or [], fmt))
+        out.extend(_render_incidents(j.get("incidents") or [], fmt))
+    if inc.get("lockgraph"):
+        out.append("")
+        out.append("lock-order edges at dump: %d" % len(inc["lockgraph"]))
     return "\n".join(out)
 
 
@@ -629,10 +880,23 @@ def main():
     parser.add_argument("--lint", action="store_true",
                         help="input is a graftlint --format json report "
                              "(python -m tools.lint --format json)")
+    parser.add_argument("--trace", metavar="ID",
+                        help="with --jsonl: render ONE trace as a "
+                             "waterfall table instead of the summary")
+    parser.add_argument("--incident", metavar="DIR",
+                        help="summarise a flight-recorder bundle "
+                             "directory (incident-<ts>-<reason>/); "
+                             "no logfile needed")
     args = parser.parse_args()
+    if args.incident:
+        print(render_incident(parse_incident(args.incident),
+                              args.format))
+        return
     lines = sys.stdin if args.logfile == "-" else open(args.logfile)
     if args.lint:
         print(render_lint(parse_lint(lines.read()), args.format))
+    elif args.trace:
+        print(render_trace(parse_jsonl(lines), args.trace, args.format))
     elif args.jsonl:
         print(render_jsonl(parse_jsonl(lines), args.format))
     else:
