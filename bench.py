@@ -1434,11 +1434,13 @@ def r06_artifact(out_path):
     if hard:
         inner["hard_failures"] = hard
     summary = {k: v for k, v in inner.items() if k != "detail"}
-    with open(out_path, "w") as f:
-        json.dump({"n": 6, "cmd": "python bench.py --r06",
-                   "rc": 3 if hard else 0,
-                   "tail": json.dumps(summary),
-                   "parsed": inner}, f, indent=1)
+    from mxnet_tpu.fsutil import atomic_write_path
+    with atomic_write_path(out_path) as tmp_out:
+        with open(tmp_out, "w") as f:
+            json.dump({"n": 6, "cmd": "python bench.py --r06",
+                       "rc": 3 if hard else 0,
+                       "tail": json.dumps(summary),
+                       "parsed": inner}, f, indent=1)
     print(json.dumps(summary))
     for h in hard:
         print("# HARD FAIL: %s" % h, file=sys.stderr)
@@ -1489,8 +1491,10 @@ def serving_artifact(out_path):
     out = {"metric": "serving_p99_ms_low_rate",
            "value": low.get("p99_ms"), "unit": "ms",
            "vs_baseline": None, "detail": details}
-    with open(out_path, "w") as f:
-        json.dump(out, f, indent=1)
+    from mxnet_tpu.fsutil import atomic_write_path
+    with atomic_write_path(out_path) as tmp_out:
+        with open(tmp_out, "w") as f:
+            json.dump(out, f, indent=1)
     print(json.dumps({k: v for k, v in out.items() if k != "detail"}))
     hard = _hard_failures(details)
     for h in hard:
@@ -1955,8 +1959,10 @@ def _update_history(details, keep=12):
                  "details": [d for d in details
                              if isinstance(d, dict) and "error" not in d]})
     try:
-        with open(_history_path(), "w") as f:
-            json.dump(hist[-keep:], f)
+        from mxnet_tpu.fsutil import atomic_write_path
+        with atomic_write_path(_history_path()) as tmp_out:
+            with open(tmp_out, "w") as f:
+                json.dump(hist[-keep:], f)
     except Exception:
         pass
 

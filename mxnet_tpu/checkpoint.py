@@ -455,6 +455,16 @@ class CheckpointManager:
                     "ckpt", "write_failed", step=step,
                     error="incomplete shard set after %.1fs"
                           % self._commit_timeout)
+                # an uncommitted step is a silent rollback on restore:
+                # capture which shards were missing while we can tell
+                flight_recorder.dump_incident(
+                    "ckpt_commit_failed",
+                    detail="incomplete shard set after %.1fs"
+                           % self._commit_timeout,
+                    extra={"step": step,
+                           "missing": [os.path.basename(p)
+                                       for p in expect
+                                       if not os.path.exists(p)]})
                 return
             time.sleep(0.02)
         man = {"format": _FORMAT, "step": step, "dp": dp,

@@ -97,8 +97,12 @@ def export_block(prefix: str, net, input_shape: Sequence[int],
         kwargs["platforms"] = list(platforms)
     exported = jexport.export(jax.jit(fn), **kwargs)(p_avals, x_aval)
     path = "%s-stablehlo.bin" % prefix
-    with open(path, "wb") as f:
-        f.write(exported.serialize())
+    # atomic (tmp + os.replace): a serving process AOT-loads these
+    # blindly at startup — it must never see a half-serialized artifact
+    from ..fsutil import atomic_write_path
+    with atomic_write_path(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(exported.serialize())
     nd.save("%s-%04d.params" % (prefix, epoch),
             {("arg:%s" % p.name): p.data() for p in params})
     return path
@@ -139,8 +143,10 @@ def export_bucketed(prefix: str, net, buckets: Sequence[int],
                                       onp.dtype(dtype))
         exported = jexport.export(jfn, **kwargs)(p_avals, x_aval)
         path = "%s-b%d-stablehlo.bin" % (prefix, b)
-        with open(path, "wb") as f:
-            f.write(exported.serialize())
+        from ..fsutil import atomic_write_path
+        with atomic_write_path(path) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(exported.serialize())
         paths.append(path)
     nd.save("%s-%04d.params" % (prefix, epoch),
             {("arg:%s" % p.name): p.data() for p in params})

@@ -519,6 +519,15 @@ def _start_liveness_heartbeat():
                 if misses >= _hb_retries():
                     telemetry.event("elastic", "publisher_giveup",
                                     rank=rank, misses=misses)
+                    # a dead publisher makes this worker look dead to
+                    # every peer: capture the journal while the "why"
+                    # (the KV errors above) is still in it
+                    from . import flight_recorder
+                    flight_recorder.dump_incident(
+                        "heartbeat_publisher_giveup",
+                        detail="publisher stopped after %d consecutive "
+                               "misses" % misses,
+                        extra={"rank": rank, "misses": misses})
                     return
             # Event.wait, not time.sleep: shutdown interrupts the
             # inter-beat pause instead of waiting out the interval.

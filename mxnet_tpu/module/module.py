@@ -385,8 +385,12 @@ class Module(BaseModule):
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            # atomic (tmp + os.replace): the .states file is part of the
+            # recovery tier — same discipline as kvstore's writer
+            from ..checkpoint import atomic_path
+            with atomic_path(fname) as tmp:
+                with open(tmp, "wb") as fout:
+                    fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

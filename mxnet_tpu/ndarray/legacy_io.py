@@ -157,5 +157,9 @@ def save_legacy(fname: str, data) -> None:
         raw = n.encode("utf-8")
         chunks.append(struct.pack("<Q", len(raw)))
         chunks.append(raw)
-    with open(fname, "wb") as f:
-        f.write(b"".join(chunks))
+    # atomic (tmp + os.replace): legacy .params containers are
+    # checkpoints too — a crash mid-save must leave the old file intact
+    from ..checkpoint import atomic_path
+    with atomic_path(fname) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(b"".join(chunks))

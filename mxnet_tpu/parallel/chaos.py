@@ -43,6 +43,20 @@ matrix in tests/test_serve_chaos.py drives all four):
   ``deadline_ms`` (default 0): the whole queue must expire through the
   pre-dispatch drop path, wasting zero dispatches.
 
+Artifact faults (every atomic tmp+``os.replace`` writer shares one
+crash window):
+
+* ``artifact_write_crash``   — ``fsutil.atomic_write_path`` raises
+  between the tmp write and the commit: the generic-artifact twin of
+  ``checkpoint_write_crash`` for telemetry exports, cost tables, bench
+  JSON and recordio indexes.
+
+``MODES`` below is the machine-readable registry of all of the above —
+``tools.lint.chaos_coverage`` parses it (as a literal, without
+importing this module) and audits that every statically-enumerated
+fault point consults a registered mode and every mode has an installing
+test.
+
 Everything is counter-based — no randomness, no wall-clock triggers —
 so a chaos test that passes once passes every time.  All fault state
 lives behind one module lock: faults are installed from the main thread
@@ -55,9 +69,27 @@ import threading
 
 __all__ = ["ChaosError", "install", "clear", "active", "fired",
            "should_fire", "maybe_kill", "maybe_stall", "garble",
-           "wrap_kv_client", "install_from_env", "ENV_VAR"]
+           "wrap_kv_client", "install_from_env", "ENV_VAR", "MODES"]
 
 ENV_VAR = "MXNET_TPU_CHAOS"
+
+# The fault-mode registry: name -> the seam that consults it.  This
+# dict is parsed as a LITERAL by tools.lint.chaos_coverage (so the
+# audit needs no import of this package) — keep it a plain dict of
+# string constants.
+MODES = {
+    "kill_worker": "parallel.elastic training loop (maybe_kill)",
+    "drop_heartbeat": "kvstore heartbeat publisher thread",
+    "kv_garble": "wrap_kv_client read proxy",
+    "kv_stall": "wrap_kv_client read proxy",
+    "checkpoint_write_crash": "checkpoint.atomic_path commit window",
+    "incident_write_crash": "flight_recorder.dump_incident publish",
+    "artifact_write_crash": "fsutil.atomic_write_path commit window",
+    "request_burst": "serve.server.InferenceServer.submit",
+    "dispatch_stall": "serve.server dispatch worker",
+    "executable_poison": "serve.server dispatch worker",
+    "deadline_storm": "serve.server.InferenceServer.submit",
+}
 
 _LOCK = threading.Lock()
 _FAULTS = {}     # name -> {"rank", "at_step", "after_calls", "times",

@@ -152,9 +152,14 @@ class MXIndexedRecordIO(MXRecordIO):
         if not self.is_open:
             return
         if self.writable:
-            with open(self.idx_path, "w") as fout:
-                for k in self.keys:
-                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+            # atomic (tmp + os.replace): a crash mid-write must not
+            # leave a truncated .idx next to a complete .rec — readers
+            # trust the sidecar blindly
+            from .fsutil import atomic_write_path
+            with atomic_write_path(self.idx_path) as tmp:
+                with open(tmp, "w") as fout:
+                    for k in self.keys:
+                        fout.write("%s\t%d\n" % (str(k), self.idx[k]))
         super().close()
 
     def read_idx(self, idx):

@@ -862,10 +862,14 @@ def export_jsonl(path):
            "compiles": snap["compiles"]}
     if _rank is not None:
         rec["rank"] = _rank
-    with open(path, "w") as f:
-        for r in events:
-            f.write(json.dumps(r, default=str) + "\n")
-        f.write(json.dumps(rec, default=str) + "\n")
+    # atomic (tmp + os.replace via fsutil): a collector must never read
+    # a torn export from a rank that died mid-dump
+    from .fsutil import atomic_write_path
+    with atomic_write_path(path) as tmp:
+        with open(tmp, "w") as f:
+            for r in events:
+                f.write(json.dumps(r, default=str) + "\n")
+            f.write(json.dumps(rec, default=str) + "\n")
     return path
 
 
@@ -905,7 +909,9 @@ def export_chrome_trace(path=None):
         out.append({"name": name, "ph": "C", "pid": pid, "ts": ts_us,
                     "args": {"value": val}})
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
-        json.dump({"traceEvents": out,
-                   "displayTimeUnit": "ms"}, f, default=str)
+    from .fsutil import atomic_write_path
+    with atomic_write_path(path) as tmp:
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": out,
+                       "displayTimeUnit": "ms"}, f, default=str)
     return path

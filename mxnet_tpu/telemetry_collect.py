@@ -31,6 +31,7 @@ import os
 import re
 import sys
 
+from .fsutil import atomic_write_path
 from .telemetry import Histogram
 
 __all__ = ["load_jsonl", "merge", "merge_histograms",
@@ -151,9 +152,10 @@ def merge_histograms(records):
 
 def write_chrome_trace(path, events):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
-                  default=str)
+    with atomic_write_path(path) as tmp:
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                      f, default=str)
     return path
 
 
@@ -163,10 +165,11 @@ def collect(paths, out, hist_out=None):
     events, hists, meta = merge(paths)
     write_chrome_trace(out, events)
     if hist_out:
-        with open(hist_out, "w") as f:
-            json.dump({name: {"summary": h.summary(),
-                              "hist": h.to_dict()}
-                       for name, h in hists.items()}, f, indent=1)
+        with atomic_write_path(hist_out) as tmp:
+            with open(tmp, "w") as f:
+                json.dump({name: {"summary": h.summary(),
+                                  "hist": h.to_dict()}
+                           for name, h in hists.items()}, f, indent=1)
     meta["histograms"] = sorted(hists)
     return meta
 

@@ -358,14 +358,16 @@ class CostTable:
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
-        tmp = self.path + ".tmp.%d" % os.getpid()
-        with open(tmp, "w") as fh:
-            for _, rec in sorted(self._entries.items(),
-                                 key=lambda kv: repr(kv[0])):
-                if rec.get("baked"):
-                    continue   # the shipped layer is read-only
-                fh.write(json.dumps(rec) + "\n")
-        os.replace(tmp, self.path)
+        # fsutil owns the tmp + os.replace discipline (and its commit
+        # window consults the artifact_write_crash chaos mode)
+        from ..fsutil import atomic_write_path
+        with atomic_write_path(self.path) as tmp:
+            with open(tmp, "w") as fh:
+                for _, rec in sorted(self._entries.items(),
+                                     key=lambda kv: repr(kv[0])):
+                    if rec.get("baked"):
+                        continue   # the shipped layer is read-only
+                    fh.write(json.dumps(rec) + "\n")
 
 
 def _reset_platform_cache():

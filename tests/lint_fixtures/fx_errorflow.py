@@ -1,0 +1,431 @@
+"""errorflow fixture: seeded violations for the five phase-5 rules with
+line-exact expectation markers, and a clean twin beside each one for
+every allowlisted idiom (journal-and-continue daemon loop, single-stmt
+best-effort probe, ``__del__`` finalizer, atomic_path / tmp+os.replace
+writes, append/streaming writers, with-managed + finally-released
+handles, first-write-wins resolution with ``done()`` / ``is None``
+guards, incident dumps reached through a helper).
+
+Never imported — parsed by the lint harness only.
+"""
+import logging
+import os
+import shutil
+import socket
+import tempfile
+import threading
+from contextlib import closing, contextmanager
+
+import numpy as np
+
+from mxnet_tpu import flight_recorder, telemetry
+
+
+# -- err-swallowed-exception -------------------------------------------------
+
+class TelemetryDaemon:
+    """Clean twin: journal-and-continue daemon loop — broad except in a
+    thread loop is the CORRECT idiom when the handler journals."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            payload = poll_source()
+            try:
+                push_upstream(payload)
+                ack_upstream(payload)
+            except Exception as e:
+                telemetry.event("daemon", "push_error", error=str(e))
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()
+
+
+class MuteDaemon:
+    """Same loop shape, but the handler swallows silently: a poisoned
+    payload spins forever with no journal trail."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            payload = poll_source()
+            try:
+                push_upstream(payload)
+                ack_upstream(payload)
+            except Exception:  # expect: err-swallowed-exception
+                pass
+
+    def close(self):
+        self._stop.set()
+        self._thread.join()
+
+
+class NativeBuffer:
+    """Cleanup-path swallow: a close() that eats its own failure hides
+    leaked native state; __del__ swallowing is the allowlisted twin."""
+
+    def __init__(self, size):
+        self._ptr = allocate_native(size)
+
+    def flush(self):
+        flush_native(self._ptr)
+
+    def close(self):
+        try:
+            self.flush()
+            release_native(self._ptr)
+        except Exception:  # expect: err-swallowed-exception
+            pass
+
+    def __del__(self):
+        # clean twin: finalizers must never raise
+        try:
+            self.flush()
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeBufferJournaling:
+    """Clean twin: the cleanup path journals before riding on."""
+
+    def __init__(self, size):
+        self._ptr = allocate_native(size)
+
+    def close(self):
+        try:
+            flush_native(self._ptr)
+            release_native(self._ptr)
+        except Exception as e:
+            logging.warning("close: release failed: %s", e)
+
+
+def parse_rank(text):
+    try:
+        rank = int(text)
+        node = text.split(":")[0]
+    except:  # expect: err-swallowed-exception
+        rank = -1
+        node = ""
+    return rank, node
+
+
+def parse_rank_ok(text):
+    # clean twin: narrow except types are fine anywhere
+    try:
+        rank = int(text)
+        node = text.split(":")[0]
+    except (ValueError, IndexError):
+        rank = -1
+        node = ""
+    return rank, node
+
+
+def best_effort_unlink(path):
+    # clean twin: single-statement best-effort probe
+    try:
+        os.remove(path)
+    except Exception:
+        pass
+
+
+def sample_metric(source):
+    # clean twin: broad except OUTSIDE thread/cleanup scope with a
+    # fallback result is ordinary defensive code, not a deadlock seed
+    try:
+        value = source.read()
+        scale = source.scale()
+    except Exception:
+        value = 0.0
+        scale = 1.0
+    return value * scale
+
+
+# -- res-nonatomic-write -----------------------------------------------------
+
+@contextmanager
+def atomic_path(path):
+    """Clean local atomic CM: structurally blessed because the
+    os.replace commit is really in the body."""
+    tmp = path + ".tmp"
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+@contextmanager
+def atomic_write_path(path):
+    """Seeded bug: an 'atomic' CM whose commit was deleted — the name
+    alone must NOT bless it (structural check)."""
+    tmp = path + ".tmp"
+    yield tmp  # expect: res-nonatomic-write
+    os.remove(tmp)
+
+
+def report_in_place(path, payload):
+    with open(path, "w") as fh:  # expect: res-nonatomic-write
+        fh.write(payload)
+
+
+def snapshot_metrics(metrics):
+    with open("metrics.json", "w") as fh:  # expect: res-nonatomic-write
+        fh.write(repr(metrics))
+
+
+def snapshot_metrics_ok(metrics):
+    # clean twin: target bound from the (structurally verified) CM
+    with atomic_path("metrics.json") as tmp:
+        with open(tmp, "w") as fh:
+            fh.write(repr(metrics))
+
+
+def snapshot_metrics_broken(metrics):
+    # the de-fanged CM above yields a tmp nobody will ever publish
+    with atomic_write_path("metrics.json") as tmp:
+        with open(tmp, "w") as fh:  # expect: res-nonatomic-write
+            fh.write(repr(metrics))
+
+
+def stash_scratch(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:  # expect: res-nonatomic-write
+        fh.write(blob)
+
+
+def rewrite_manifest(path, lines):
+    # clean twin: inline tmp + os.replace commit in the same scope
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(lines))
+    os.replace(tmp, path)
+
+
+def export_table(path, cols):
+    np.savez(path, **cols)  # expect: res-nonatomic-write
+
+
+def export_table_ok(path, cols):
+    # clean twin: savez onto the CM-provided tmp path
+    with atomic_path(path) as tmp:
+        np.savez(tmp, **cols)
+
+
+def journal_append(path, line):
+    # clean twin: append mode is the incremental-format idiom
+    with open(path, "a") as fh:
+        fh.write(line)
+
+
+class StreamingWriter:
+    """Clean twin: ``self.fh = open(...)`` streaming-writer idiom — the
+    handle outlives the call and the format is incremental."""
+
+    def __init__(self, path):
+        self.fh = open(path, "wb")
+
+    def append(self, chunk):
+        self.fh.write(chunk)
+
+    def close(self):
+        self.fh.close()
+
+
+def open_writer(path):
+    # handle-returning helper: judged at its call sites, not here
+    return open(path, "w")
+
+
+def dump_via_helper(path):
+    fh = open_writer(path)  # expect: res-nonatomic-write
+    fh.write("payload")
+    fh.close()
+
+
+def dump_via_helper_ok(path):
+    # clean twin: the helper's handle lands on a blessed tmp path
+    with atomic_path(path) as tmp:
+        fh = open_writer(tmp)
+        fh.write("payload")
+        fh.close()
+
+
+def _write_payload(path, blob):
+    # receives the target path: judged at each resolved call site
+    with open(path, "w") as fh:
+        fh.write(blob)
+
+
+def publish_report(blob):
+    _write_payload("report.json", blob)  # expect: res-nonatomic-write
+
+
+def publish_report_ok(blob):
+    # clean twin: call site feeds the helper a blessed tmp path
+    with atomic_path("report.json") as tmp:
+        _write_payload(tmp, blob)
+
+
+# -- res-leaked-handle -------------------------------------------------------
+
+def read_config_leaky(path):
+    fh = open(path)  # expect: res-leaked-handle
+    data = fh.read()
+    fh.close()
+    return data
+
+
+def read_config_ok(path):
+    # clean twin: finally-reachable release survives exception edges
+    fh = open(path)
+    try:
+        return fh.read()
+    finally:
+        fh.close()
+
+
+def read_config_with(path):
+    # clean twin: with-managed handle
+    with open(path) as fh:
+        return fh.read()
+
+
+def probe_endpoint_leaky(host):
+    s = socket.socket()  # expect: res-leaked-handle
+    s.connect((host, 80))
+    s.close()
+
+
+def probe_endpoint_ok(host):
+    # clean twin: closing() wraps the acquisition in a with block
+    with closing(socket.socket()) as s:
+        s.connect((host, 80))
+
+
+def scratch_build_leaky():
+    d = tempfile.mkdtemp()  # expect: res-leaked-handle
+    scratch = d + "/artifact.bin"
+    with open(scratch, "wb") as fh:
+        fh.write(b"x")
+    return scratch
+
+
+def scratch_build_ok():
+    # clean twin: temp dir removed on the finally edge
+    d = tempfile.mkdtemp()
+    try:
+        with open(d + "/artifact.bin", "wb") as fh:
+            fh.write(b"x")
+    finally:
+        shutil.rmtree(d)
+
+
+# -- err-terminal-outcome ----------------------------------------------------
+
+class PendingRequest:
+    """First-write-wins terminal-outcome stub (the serve API shape)."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.deadline = 0.0
+        self._outcome = None
+
+    def _resolve(self, kind, reason=None):
+        if self._outcome is not None:
+            return False
+        self._outcome = (kind, reason)
+        return True
+
+    def done(self):
+        return self._outcome is not None
+
+
+def admit(queue_, payload):  # expect: err-terminal-outcome
+    req = PendingRequest(payload)
+    if queue_full(queue_):
+        return None          # hung client: req never resolved
+    queue_.put(req)
+    return req
+
+
+def admit_ok(queue_, payload):
+    # clean twin: the backpressure path resolves before returning
+    req = PendingRequest(payload)
+    if queue_full(queue_):
+        req._resolve("reject", reason="backpressure")
+        return req
+    queue_.put(req)
+    return req
+
+
+def drop_expired(reqs, now):
+    live = []
+    for r in reqs:  # expect: err-terminal-outcome
+        if r.deadline <= now:
+            count_drop()     # dropped from the batch but never resolved
+        elif not r.done():
+            live.append(r)
+    return live
+
+
+def drop_expired_ok(reqs, now):
+    # clean twin: the real shape — expired requests resolve as timeouts
+    live = []
+    for r in reqs:
+        if r.deadline <= now:
+            if r._resolve("timeout", reason="deadline"):
+                count_drop()
+        elif not r.done():
+            live.append(r)
+    return live
+
+
+def finish(req, value):
+    # clean twin: `is None` null-guard exempts that branch
+    if req is None:
+        return
+    req._resolve("result", reason=value)
+
+
+def expire(req):
+    # clean twin: first-write-wins `done()` guard
+    if req.done():
+        return
+    req._resolve("timeout", reason="watchdog")
+
+
+# -- err-incident-trigger ----------------------------------------------------
+
+def journal_giveup(rank, misses):
+    telemetry.event("elastic", "publisher_giveup",  # expect: err-incident-trigger
+                    rank=rank, misses=misses)
+
+
+def journal_giveup_ok(rank, misses):
+    # clean twin: terminal failure event paired with a postmortem dump
+    telemetry.event("elastic", "publisher_giveup", rank=rank,
+                    misses=misses)
+    flight_recorder.dump_incident("publisher_giveup",
+                                  extra={"rank": rank})
+
+
+def quarantine_bucket(bucket):
+    # clean twin: the dump is reachable through a resolved helper
+    telemetry.event("serve", "quarantine", bucket=str(bucket))
+    _leave_postmortem(bucket)
+
+
+def _leave_postmortem(bucket):
+    flight_recorder.dump_incident("bucket_quarantine",
+                                  extra={"bucket": str(bucket)})

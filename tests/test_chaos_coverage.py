@@ -1,0 +1,206 @@
+"""Fault-injection coverage auditor (tools.lint.chaos_coverage).
+
+Tier-1 half: the REAL package must audit clean — every statically
+enumerated fault point (os.replace commit windows, thread entries, KV
+ops) has a chaos injection or a load-bearing waiver, every registered
+mode is consulted by a seam and installed by a test.  Synthetic-tree
+halves: each closure violation class is detected, and the waiver
+machinery cannot rot.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.lint import chaos_coverage  # noqa: E402
+
+
+# -- tier-1: the real package ------------------------------------------------
+
+def test_package_chaos_coverage_ok():
+    res = chaos_coverage.audit()
+    assert res.ok, "\n".join(res.problems)
+    # the registry covers the full failure-model surface
+    for mode in ("kill_worker", "drop_heartbeat", "kv_garble",
+                 "kv_stall", "checkpoint_write_crash",
+                 "incident_write_crash", "artifact_write_crash",
+                 "request_burst", "dispatch_stall", "executable_poison",
+                 "deadline_storm"):
+        assert mode in res.registry, mode
+        assert res.consultations.get(mode), "mode %s never consulted" % mode
+        assert res.tests.get(mode), "mode %s has no installing test" % mode
+    assert not [p for p in res.points if p.status == "uncovered"]
+    # the phase-5 fsutil commit window is enumerated and injected
+    assert any(p.path.endswith("fsutil.py")
+               and p.kind == "commit-window"
+               and p.status == "covered"
+               and "artifact_write_crash" in p.modes
+               for p in res.points), [p.to_dict() for p in res.points]
+    # checkpoint commit window rides its own mode
+    assert any(p.path.endswith("checkpoint.py")
+               and p.kind == "commit-window"
+               and "checkpoint_write_crash" in p.modes
+               for p in res.points)
+
+
+def test_audit_chaos_cli_json():
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--audit-chaos",
+         "--format", "json"],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    data = json.loads(res.stdout)
+    assert data["ok"] is True
+    assert data["modes"]["artifact_write_crash"]["tests"]
+    kinds = {p["kind"] for p in data["fault_points"]}
+    assert kinds >= {"commit-window", "thread-entry", "kv-op"}, kinds
+
+
+# -- synthetic trees: each violation class -----------------------------------
+
+_CHAOS_OK = ("MODES = {'write_crash': 'writer.commit window'}\n"
+             "\n"
+             "\n"
+             "def should_fire(mode, **kw):\n"
+             "    return False\n")
+
+_WRITER_OK = ("import os\n"
+              "\n"
+              "from .parallel import chaos\n"
+              "\n"
+              "\n"
+              "def commit(tmp, path):\n"
+              "    if chaos.should_fire('write_crash'):\n"
+              "        raise RuntimeError('injected')\n"
+              "    os.replace(tmp, path)\n")
+
+_TEST_OK = "chaos.install(\"write_crash\", times=1)\n"
+
+
+def _tree(tmp_path, chaos_src=_CHAOS_OK, writer_src=_WRITER_OK,
+          test_src=_TEST_OK, extra=None):
+    pkg = tmp_path / "pkg"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "parallel" / "__init__.py").write_text("")
+    (pkg / "parallel" / "chaos.py").write_text(chaos_src)
+    (pkg / "writer.py").write_text(writer_src)
+    for relname, src in (extra or {}).items():
+        dest = pkg / relname
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_text(src)
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_seeded.py").write_text(test_src)
+    return chaos_coverage.audit(paths=[str(pkg)], root=str(tmp_path),
+                                tests_dir=str(tdir))
+
+
+def test_clean_synthetic_tree_audits_ok(tmp_path):
+    res = _tree(tmp_path)
+    assert res.ok, "\n".join(res.problems)
+    assert [p.kind for p in res.points] == ["commit-window"]
+    assert res.points[0].status == "covered"
+    assert res.points[0].modes == ("write_crash",)
+
+
+def test_uncovered_commit_window_fails(tmp_path):
+    # the os.replace window lost its consultation; the mode is still
+    # consulted elsewhere so ONLY the fault-point problem fires
+    bugged = ("import os\n"
+              "\n"
+              "from .parallel import chaos\n"
+              "\n"
+              "\n"
+              "def commit(tmp, path):\n"
+              "    os.replace(tmp, path)\n"
+              "\n"
+              "\n"
+              "def elsewhere():\n"
+              "    return chaos.should_fire('write_crash')\n")
+    res = _tree(tmp_path, writer_src=bugged)
+    assert not res.ok
+    assert any("commit-window" in p and "no chaos injection" in p
+               for p in res.problems), res.problems
+
+
+def test_uncovered_thread_entry_fails(tmp_path):
+    spawner = ("import threading\n"
+               "\n"
+               "\n"
+               "def _loop():\n"
+               "    return None\n"
+               "\n"
+               "\n"
+               "def start():\n"
+               "    threading.Thread(target=_loop, daemon=True).start()\n")
+    res = _tree(tmp_path, extra={"spawner.py": spawner})
+    assert not res.ok
+    assert any("thread-entry" in p and "_loop" in p
+               for p in res.problems), res.problems
+
+
+def test_mode_without_installing_test_fails(tmp_path):
+    res = _tree(tmp_path, test_src="def test_nothing():\n    pass\n")
+    assert not res.ok
+    assert any("no installing test" in p for p in res.problems), \
+        res.problems
+
+
+def test_consultation_missing_from_registry_fails(tmp_path):
+    ghost = _WRITER_OK + ("\n"
+                          "\n"
+                          "def spooky():\n"
+                          "    return chaos.should_fire('ghost_mode')\n")
+    res = _tree(tmp_path, writer_src=ghost)
+    assert not res.ok
+    assert any("ghost_mode" in p and "missing from the MODES registry"
+               in p for p in res.problems), res.problems
+
+
+def test_registered_mode_never_consulted_fails(tmp_path):
+    chaos_src = _CHAOS_OK.replace(
+        "MODES = {'write_crash': 'writer.commit window'}",
+        "MODES = {'write_crash': 'writer.commit window',\n"
+        "         'dead_mode': 'nothing consults this'}")
+    test_src = _TEST_OK + "chaos.install(\"dead_mode\")\n"
+    res = _tree(tmp_path, chaos_src=chaos_src, test_src=test_src)
+    assert not res.ok
+    assert any("dead_mode" in p and "no seam consults it" in p
+               for p in res.problems), res.problems
+
+
+def test_missing_registry_fails(tmp_path):
+    res = _tree(tmp_path, chaos_src="def should_fire(m, **kw):\n"
+                                    "    return False\n")
+    assert not res.ok
+    assert any("no MODES registry" in p for p in res.problems)
+
+
+def test_stale_waiver_detected(tmp_path):
+    # a file matching a waiver suffix exists but contains no matching
+    # fault point: the waiver is stale and must fail the audit
+    res = _tree(tmp_path, extra={
+        "native/__init__.py": "def _build():\n    return None\n"})
+    assert not res.ok
+    assert any("stale waiver" in p and "native/__init__.py" in p
+               for p in res.problems), res.problems
+
+
+def test_waiver_covers_matching_site(tmp_path):
+    # the same file WITH the waived fault point: waived, audit ok
+    native = ("import os\n"
+              "\n"
+              "\n"
+              "def _build(tmp, path):\n"
+              "    os.replace(tmp, path)\n")
+    res = _tree(tmp_path, extra={"native/__init__.py": native})
+    assert res.ok, "\n".join(res.problems)
+    waived = [p for p in res.points if p.status == "waived"]
+    assert len(waived) == 1 and waived[0].context == "_build"
+    assert "fall" in waived[0].note or waived[0].note

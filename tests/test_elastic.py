@@ -9,6 +9,7 @@ multiprocess protocol half (heartbeat detection across real OS
 processes, manifest-based restart) lives in test_dist_multiprocess.py.
 """
 import os
+import time
 
 import numpy as onp
 import pytest
@@ -348,6 +349,23 @@ def test_chaos_kv_proxy_garbles_reads():
         float(garbled)          # garbled payloads must not parse
     assert proxy.blocking_key_value_get("k", 50) == "1234.5"
     assert proxy.other() == "ok"
+
+
+def test_chaos_kv_proxy_stalls_reads():
+    """``kv_stall`` blocks proxied reads for its ``delay`` — the
+    struggling-coordinator fault the kv_retry backoff path absorbs."""
+    class C:
+        def blocking_key_value_get(self, key, t):
+            return "1234.5"
+
+    proxy = chaos.wrap_kv_client(C())
+    chaos.install("kv_stall", times=1, delay=0.05)
+    t0 = time.monotonic()
+    assert proxy.blocking_key_value_get("k", 50) == "1234.5"
+    assert time.monotonic() - t0 >= 0.05      # stalled, payload intact
+    t0 = time.monotonic()
+    assert proxy.blocking_key_value_get("k", 50) == "1234.5"
+    assert time.monotonic() - t0 < 0.05       # times=1: back to fast
 
 
 def test_chaos_install_from_env(monkeypatch):

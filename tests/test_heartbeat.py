@@ -193,8 +193,10 @@ def test_publisher_backoff_giveup_journals_once(publisher_env,
     past the bounded retry budget makes the publisher exit — with
     every miss counted in ``elastic.heartbeat_misses`` and EXACTLY ONE
     ``elastic/publisher_giveup`` journal event — instead of the old
-    hard 5-consecutive-miss silent exit."""
-    from mxnet_tpu import telemetry
+    hard 5-consecutive-miss silent exit.  The give-up also dumps an
+    incident bundle (err-incident-trigger contract: a worker that goes
+    dark to its peers must leave a postmortem)."""
+    from mxnet_tpu import flight_recorder, telemetry
     client = publisher_env
     client.fail_sets = True
     monkeypatch.setenv("MXNET_TPU_HEARTBEAT_RETRIES", "2")
@@ -209,6 +211,8 @@ def test_publisher_backoff_giveup_journals_once(publisher_env,
     ev = [e for e in telemetry.snapshot(events=512)["events"]
           if e["kind"] == "elastic" and e["name"] == "publisher_giveup"]
     assert len(ev) == 1 and ev[0]["misses"] == 2 and ev[0]["rank"] == 0
+    assert flight_recorder.bundles_dumped() == 1, \
+        "publisher give-up must leave an incident bundle"
 
 
 def test_publisher_backoff_spacing(publisher_env, monkeypatch):

@@ -43,7 +43,8 @@ def _lint_fixture(name):
 @pytest.mark.parametrize("name", ["fx_trace.py", "fx_retrace.py",
                                   "fx_donation.py", "fx_pallas.py",
                                   "fx_sharding.py", "fx_concurrency.py",
-                                  "fx_numerics.py", "fx_tune.py"])
+                                  "fx_numerics.py", "fx_tune.py",
+                                  "fx_errorflow.py"])
 def test_fixture_rules_and_lines(name):
     path, result = _lint_fixture(name)
     got = {(f.rule, f.line) for f in result.new}
@@ -306,6 +307,143 @@ def test_seeded_lowprec_accum_fails_the_gate(tmp_path):
         "\n".join(f.render() for f in result.new)
 
 
+def _load_copy(path, name):
+    """Import a seeded module copy under the package namespace so its
+    relative imports resolve."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_seeded_dropped_commit_fails_gate_and_tears_at_runtime(tmp_path):
+    """Acceptance (errorflow): deleting the ``os.replace`` commit from a
+    checkpoint.py copy's atomic_path must (a) trip res-nonatomic-write
+    statically — the CM is blessed STRUCTURALLY, not by name — and
+    (b) reproduce the hazard dynamically: writes through the de-fanged
+    CM never reach the target.  The pristine copy is clean both ways."""
+    src = open(os.path.join(REPO, "mxnet_tpu", "checkpoint.py")).read()
+    clean = tmp_path / "ckpt_clean.py"
+    clean.write_text(src)
+    result = run_lint([str(clean)], baseline_path=None)
+    assert not result.new, "\n".join(f.render() for f in result.new)
+
+    bugged = src.replace("        os.replace(tmp, path)\n", "")
+    assert bugged != src, "seeding site moved — update the test"
+    bad = tmp_path / "ckpt_bug.py"
+    bad.write_text(bugged)
+    result = run_lint([str(bad)], baseline_path=None)
+    rules = {f.rule for f in result.new}
+    assert "res-nonatomic-write" in rules, \
+        "\n".join(f.render() for f in result.new)
+
+    # runtime half: the same seed, executed — the commit never lands
+    good_mod = _load_copy(clean, "mxnet_tpu._seeded_ckpt_clean")
+    target = tmp_path / "artifact.json"
+    with good_mod.atomic_path(str(target)) as tmp:
+        with open(tmp, "w") as f:
+            f.write("{}")
+    assert target.exists()                  # pristine copy commits
+    target2 = tmp_path / "artifact2.json"
+    bad_mod = _load_copy(bad, "mxnet_tpu._seeded_ckpt_bug")
+    with bad_mod.atomic_path(str(target2)) as tmp:
+        with open(tmp, "w") as f:
+            f.write("{}")
+    assert not target2.exists(), \
+        "seeded copy still committed — the static finding lied"
+
+
+def test_seeded_dropped_resolve_fails_gate_and_hangs_at_runtime(tmp_path):
+    """Acceptance (errorflow): dropping the ``r._resolve("timeout")``
+    from a serve/server.py copy's _drop_expired must (a) trip
+    err-terminal-outcome statically — the var stays tracked through its
+    ``done()`` guard — and (b) reproduce the hang dynamically: an
+    expired request dropped by the seeded copy never gets an outcome.
+    The pristine copy is clean and resolves."""
+    import time
+    src = open(os.path.join(REPO, "mxnet_tpu", "serve",
+                            "server.py")).read()
+    clean = tmp_path / "server_clean.py"
+    clean.write_text(src)
+    result = run_lint([str(clean)], baseline_path=None)
+    assert not result.new, "\n".join(f.render() for f in result.new)
+
+    seed_old = (
+        'if r._resolve("timeout",\n'
+        '                              reason="deadline expired in %s"'
+        ' % stage):\n'
+        '                    telemetry.inc("serve.timeouts")\n'
+        '                    telemetry.inc("serve.deadline_drops")\n'
+        '                    telemetry.event("serve", "timeout",'
+        ' stage=stage)\n')
+    seed_new = 'telemetry.inc("serve.deadline_drops")\n'
+    bugged = src.replace(seed_old, seed_new)
+    assert bugged != src, "seeding site moved — update the test"
+    bad = tmp_path / "server_bug.py"
+    bad.write_text(bugged)
+    result = run_lint([str(bad)], baseline_path=None)
+    findings = [f for f in result.new if f.rule == "err-terminal-outcome"]
+    assert findings, "\n".join(f.render() for f in result.new)
+    assert any(f.context.endswith("_drop_expired") for f in findings), \
+        [f.context for f in findings]
+
+    # runtime half: an expired request through each copy's batcher drop
+    good_mod = _load_copy(clean, "mxnet_tpu.serve._seeded_server_clean")
+    r = good_mod.PendingRequest(None, time.monotonic() - 1.0)
+    live = good_mod.InferenceServer._drop_expired(None, [r], "queue")
+    assert live == [] and r.outcome(0) is not None
+    assert r.outcome(0)[0] == "timeout"     # pristine copy resolves
+
+    bad_mod = _load_copy(bad, "mxnet_tpu.serve._seeded_server_bug")
+    r = bad_mod.PendingRequest(None, time.monotonic() - 1.0)
+    live = bad_mod.InferenceServer._drop_expired(None, [r], "queue")
+    assert live == []
+    assert r.outcome(0) is None, \
+        "seeded copy still resolved — the static finding lied"
+
+
+def test_changed_closure_covers_errorflow_rules(tmp_path):
+    """Satellite: --changed's reverse-dependency closure must pull
+    err-*/res-* findings in an IMPORTER of the changed file — the
+    write-helper judgment lands at the call site, cross-module."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(
+        "class PendingRequest:\n"
+        "    def _resolve(self, kind):\n"
+        "        return True\n"
+        "\n"
+        "\n"
+        "def dump(path, blob):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        fh.write(blob)\n")
+    (pkg / "worker.py").write_text(
+        "from .helper import PendingRequest, dump\n"
+        "\n"
+        "\n"
+        "def publish(blob):\n"
+        "    dump('report.json', blob)\n"
+        "\n"
+        "\n"
+        "def admit(q, blob):\n"
+        "    req = PendingRequest(blob)\n"
+        "    if q.full():\n"
+        "        return None\n"
+        "    q.put(req)\n"
+        "    return req\n")
+    relbase = os.path.relpath(str(pkg), REPO).replace(os.sep, "/")
+    helper_rel = relbase + "/helper.py"
+    worker_rel = relbase + "/worker.py"
+    result = run_lint([str(tmp_path)], baseline_path=None,
+                      changed_files=[helper_rel])
+    assert worker_rel in result.files
+    rules = {(f.path, f.rule) for f in result.new}
+    assert (worker_rel, "res-nonatomic-write") in rules, sorted(rules)
+    assert (worker_rel, "err-terminal-outcome") in rules, sorted(rules)
+
+
 def test_changed_closure_covers_num_rules(tmp_path):
     """Satellite: --changed's reverse-dependency closure must pull a
     numerics finding in an IMPORTER of the changed file (the dtype-flow
@@ -419,6 +557,11 @@ def test_list_rules_groups_by_family():
                  "num-unstable-exp", "num-master-dtype",
                  "num-collective-dtype", "num-const-downcast"):
         assert fam_of.get(rule) == "numerics", (rule, fam_of.get(rule))
+    assert "errorflow:" in lines
+    for rule in ("err-swallowed-exception", "res-nonatomic-write",
+                 "res-leaked-handle", "err-terminal-outcome",
+                 "err-incident-trigger"):
+        assert fam_of.get(rule) == "errorflow", (rule, fam_of.get(rule))
 
 
 def test_stale_suppression_audit(tmp_path):

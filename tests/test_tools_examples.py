@@ -156,17 +156,60 @@ def test_parse_log_lint_report_rule_families():
             {"rule": "num-lowprec-accum", "path": "m.py", "line": 12,
              "col": 0, "message": "sum() accumulates in bfloat16",
              "context": "h"},
+            {"rule": "res-nonatomic-write", "path": "m.py", "line": 20,
+             "col": 0, "message": "durable artifact written in place",
+             "context": "w"},
+            {"rule": "err-terminal-outcome", "path": "m.py", "line": 31,
+             "col": 0, "message": "request can exit unresolved",
+             "context": "v"},
         ],
     }
     agg = parse_log.parse_lint(json.dumps(report))
     assert agg["by_rule"] == {"shard-axis-unknown": 1,
                               "trace-host-sync": 1,
-                              "num-lowprec-accum": 1}
+                              "num-lowprec-accum": 1,
+                              "res-nonatomic-write": 1,
+                              "err-terminal-outcome": 1}
     out = parse_log.render_lint(agg)
     assert "| sharding | shard-axis-unknown | 1 |" in out
     assert "| trace-safety | trace-host-sync | 1 |" in out
     assert "| numerics | num-lowprec-accum | 1 |" in out
+    # the errorflow family groups BOTH its prefixes (err-*, res-*)
+    assert "| errorflow | err-terminal-outcome | 1 |" in out
+    assert "| errorflow | res-nonatomic-write | 1 |" in out
     assert "axis 'pd' undeclared" in out
+
+
+def test_parse_log_chaos_audit_matrix_roundtrip(tmp_path):
+    """Round-trip: --audit-chaos --telemetry journals the coverage
+    matrix (lint/chaos_audit event); parse_log --jsonl renders it as
+    the fault point | injection | covering test table."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    from mxnet_tpu import telemetry
+    from tools.lint import chaos_coverage
+
+    res = chaos_coverage.audit()
+    assert res.ok, "\n".join(res.problems)
+    telemetry.reset()
+    chaos_coverage.emit_telemetry(res)
+    path = tmp_path / "journal.jsonl"
+    telemetry.export_jsonl(str(path))
+    telemetry.reset()
+
+    with open(path) as f:
+        agg = parse_log.parse_jsonl(f)
+    rec = agg["chaos_audit"]
+    assert rec and rec["ok"] is True
+    assert rec["points"] == len(res.points) and rec["matrix"]
+    out = parse_log.render_jsonl(agg)
+    assert "chaos coverage (OK):" in out
+    assert "| fault point | site | injection | covering test |" in out
+    # the fsutil commit window row carries its mode and its test
+    row = next(l for l in out.splitlines()
+               if "fsutil.py" in l and "commit-window" in l)
+    assert "artifact_write_crash" in row
+    assert "tests/test_atomic_artifacts.py" in row
 
 
 def test_parse_log_hbm_journal_table(tmp_path):

@@ -190,8 +190,11 @@ def main(argv=None):
     }
     text = json.dumps(report, indent=1, sort_keys=True)
     if args.json_out:
-        with open(args.json_out, "w") as f:
+        # atomic report: CI consumers may read while a retry rewrites
+        tmp_report = args.json_out + ".tmp.%d" % os.getpid()
+        with open(tmp_report, "w") as f:
             f.write(text + "\n")
+        os.replace(tmp_report, args.json_out)
         print("wrote %s" % args.json_out)
     else:
         print(text)

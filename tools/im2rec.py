@@ -14,7 +14,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from mxnet_tpu import recordio  # noqa: E402
+from mxnet_tpu import fsutil, recordio  # noqa: E402
 
 EXTS = (".jpg", ".jpeg", ".png", ".bmp")
 
@@ -39,9 +39,10 @@ def make_list(args):
         random.seed(100)
         random.shuffle(image_list)
     fname = args.prefix + ".lst"
-    with open(fname, "w") as f:
-        for i, (path, lab) in enumerate(image_list):
-            f.write("%d\t%f\t%s\n" % (i, lab, path))
+    with fsutil.atomic_write_path(fname) as tmp_lst:
+        with open(tmp_lst, "w") as f:
+            for i, (path, lab) in enumerate(image_list):
+                f.write("%d\t%f\t%s\n" % (i, lab, path))
     print("wrote %s (%d images, %d classes)" % (fname, len(image_list),
                                                 label))
 

@@ -1,7 +1,7 @@
 """graftlint — framework-aware static analysis for the mxnet-tpu JAX
 training stack.
 
-Seven checkers (see docs/LINTING.md for the rule catalog):
+Eight checkers (see docs/LINTING.md for the rule catalog):
 
 * trace-safety  — host-sync escapes inside jit-reachable code
 * retrace       — static recompile hazards (the compile-time complement
@@ -24,6 +24,13 @@ Seven checkers (see docs/LINTING.md for the rule catalog):
                   float64-under-disabled-x64 surprises; its runtime
                   counterpart is the numerics sanitizer in
                   ``tools.lint.runtime_numerics``
+* errorflow     — exception-flow & resource lifecycle: swallowed
+                  exceptions in thread/cleanup paths, non-atomic
+                  durable-artifact writes, leaked handles on exception
+                  edges, PendingRequest terminal-outcome dataflow,
+                  incident-trigger drift; its runtime counterpart is
+                  the fault-injection coverage auditor in
+                  ``tools.lint.chaos_coverage`` (``--audit-chaos``)
 
 Run ``python -m tools.lint mxnet_tpu/`` (text or ``--format json``);
 ``--changed`` lints only files touched vs ``git merge-base HEAD main``
@@ -38,8 +45,8 @@ or grandfathered in ``tools/lint/baseline.json``; the tier-1 gate
 """
 from __future__ import annotations
 
-from . import concurrency, donation, numerics, pallas, retrace, \
-    sharding, trace_safety
+from . import concurrency, donation, errorflow, numerics, pallas, \
+    retrace, sharding, trace_safety
 from .core import (Finding, LintResult, ModuleInfo, default_baseline_path,
                    diff_baseline, load_baseline, run_lint, write_baseline)
 
@@ -48,7 +55,7 @@ __all__ = ["CHECKERS", "all_rules", "rule_family", "run_lint", "Finding",
            "diff_baseline", "default_baseline_path"]
 
 CHECKERS = (trace_safety, retrace, donation, pallas, sharding,
-            concurrency, numerics)
+            concurrency, numerics, errorflow)
 
 # rules owned by the runner itself (suppression hygiene)
 _META_RULES = {
@@ -74,7 +81,8 @@ def all_rules() -> dict:
 _RULE_FAMILIES = {"trace": "trace-safety", "retrace": "retrace",
                   "donate": "donation", "pallas": "pallas",
                   "shard": "sharding", "conc": "concurrency",
-                  "num": "numerics", "lint": "meta"}
+                  "num": "numerics", "err": "errorflow",
+                  "res": "errorflow", "lint": "meta"}
 
 
 def rule_family(rule: str) -> str:

@@ -72,6 +72,11 @@ def main(argv=None) -> int:
                     help="flag inline suppressions whose rule no longer "
                          "fires on their line (always on under "
                          "--write-baseline)")
+    ap.add_argument("--audit-chaos", action="store_true",
+                    help="audit fault-injection coverage: every "
+                         "statically-enumerated fault point must map to "
+                         "a chaos mode and an installing test "
+                         "(tools.lint.chaos_coverage)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -90,6 +95,19 @@ def main(argv=None) -> int:
         if not os.path.exists(p):
             print("error: no such path: %s" % p, file=sys.stderr)
             return 2
+
+    if args.audit_chaos:
+        from . import chaos_coverage
+        res = chaos_coverage.audit(
+            None if args.paths == [] or not args.paths else paths)
+        if args.telemetry:
+            chaos_coverage.emit_telemetry(res)
+        if args.format == "json":
+            json.dump(res.to_dict(), sys.stdout, indent=1)
+            sys.stdout.write("\n")
+        else:
+            print(res.render_text())
+        return 0 if res.ok else 1
 
     baseline = None if (args.no_baseline or args.write_baseline) \
         else (args.baseline or default_baseline_path())

@@ -1,0 +1,414 @@
+"""chaos_coverage — fault-injection coverage auditor (phase 5 runtime
+cross-check).
+
+``errorflow`` proves the error-handling *disciplines* hold statically;
+this module audits that the *failure modes* those disciplines exist for
+are actually injectable and injected.  It statically enumerates the
+package's fault points —
+
+* every ``os.replace`` commit window (the crash instant atomicity
+  exists to survive),
+* every host-thread entry from the PR-7 concurrency model (a thread
+  that dies or stalls silently is a hang),
+* every KV coordinator op behind ``kv_retry`` (the seam a struggling
+  coordinator perturbs),
+
+— and maps them against the chaos-mode registry (``MODES`` in
+``mxnet_tpu/parallel/chaos.py``, parsed as a literal so the audit
+imports nothing from the package) and against the tests that install
+each mode.  The audit FAILS when:
+
+* a fault point has no reachable chaos consultation and no waiver,
+* a registered mode is never consulted by any seam,
+* a consulted mode is missing from the registry,
+* a registered mode has no test installing it.
+
+Explicit waivers (below) document the fault points that are
+legitimately outside the switchboard — e.g. the native-extension build
+cache, whose failure path is "fall back to eager", exercised without
+injection.  A waiver names its site; when the site disappears the
+waiver goes stale and the audit fails, so waivers cannot rot.
+
+This is the same static-vs-runtime closure the LockOrderSanitizer and
+NumericsSanitizer established: the static model enumerates, the runtime
+harness must cover, and the gate holds the two together.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import ModuleInfo, _repo_root, collect_files
+from .jitgraph import PackageIndex, call_target_name, call_target_parts
+
+# mode-name-bearing consultation entry points in parallel/chaos.py
+_CONSULT_FNS = {"should_fire", "maybe_stall", "active"}
+
+# (relpath suffix, context qualname, reason) — fault points the chaos
+# switchboard intentionally does not reach.  Keep reasons load-bearing:
+# they are printed in the audit matrix.
+WAIVERS: Tuple[Tuple[str, str, str], ...] = (
+    ("native/__init__.py", "_build",
+     "one-shot import-time build cache: a torn .so is rebuilt on next "
+     "import and every failure path falls back to the eager kernels"),
+    ("io/device_prefetch.py", "DevicePrefetchIter._feed",
+     "feeder faults are driven through the upstream iterator "
+     "(StopIteration / raising source), not the chaos switchboard"),
+    ("io/io.py", "_Producer._run",
+     "single-epoch producer: its only fault path is the child "
+     "iterator raising/exhausting, exercised by the io restart tests"),
+)
+
+
+@dataclass
+class FaultPoint:
+    kind: str            # commit-window | thread-entry | kv-op
+    path: str
+    line: int
+    context: str
+    modes: Tuple[str, ...] = ()
+    status: str = "uncovered"     # covered | waived | uncovered
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "path": self.path, "line": self.line,
+                "context": self.context, "modes": list(self.modes),
+                "status": self.status, "note": self.note}
+
+
+@dataclass
+class ChaosAudit:
+    registry: Dict[str, str] = field(default_factory=dict)
+    points: List[FaultPoint] = field(default_factory=list)
+    consultations: Dict[str, List[str]] = field(default_factory=dict)
+    tests: Dict[str, List[str]] = field(default_factory=dict)
+    problems: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "modes": {
+                m: {"seam": self.registry[m],
+                    "consultations": self.consultations.get(m, []),
+                    "tests": self.tests.get(m, [])}
+                for m in sorted(self.registry)},
+            "fault_points": [p.to_dict() for p in self.points],
+            "problems": list(self.problems),
+        }
+
+    def render_text(self) -> str:
+        out = ["chaos coverage: %d mode(s), %d fault point(s)"
+               % (len(self.registry), len(self.points))]
+        out.append("%-24s %-38s %s" % ("mode", "consulted at",
+                                       "installed by"))
+        for m in sorted(self.registry):
+            cons = self.consultations.get(m, [])
+            tst = self.tests.get(m, [])
+            out.append("%-24s %-38s %s" % (
+                m, cons[0] if cons else "<never>",
+                ", ".join(tst) if tst else "<no test>"))
+        out.append("")
+        out.append("%-14s %-42s %-9s %s" % ("fault point", "site",
+                                            "status", "injection"))
+        for p in self.points:
+            out.append("%-14s %-42s %-9s %s" % (
+                p.kind, "%s:%d (%s)" % (p.path, p.line, p.context),
+                p.status,
+                ", ".join(p.modes) if p.modes else (p.note or "-")))
+        for prob in self.problems:
+            out.append("PROBLEM: " + prob)
+        out.append("chaos coverage: %s"
+                   % ("OK" if self.ok else
+                      "%d problem(s)" % len(self.problems)))
+        return "\n".join(out)
+
+
+def _load_registry(modules: Sequence[ModuleInfo]) -> Dict[str, str]:
+    for m in modules:
+        if not m.relpath.endswith("parallel/chaos.py"):
+            continue
+        for node in m.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "MODES":
+                try:
+                    reg = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return {}
+                if isinstance(reg, dict):
+                    return {str(k): str(v) for k, v in reg.items()}
+    return {}
+
+
+def _consultations(index: PackageIndex) -> Dict[str, List[Tuple]]:
+    """mode -> [(relpath, line, scope-FunctionInfo)] for every
+    mode-naming chaos consultation in the package."""
+    out: Dict[str, List[Tuple]] = {}
+    for cs in index.call_sites:
+        name = call_target_parts(cs.node)[-1:]
+        name = name[0] if name else None
+        mode = None
+        if name in _CONSULT_FNS and cs.node.args:
+            a0 = cs.node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                mode = a0.value
+        elif name == "maybe_kill":
+            mode = "kill_worker"
+        if mode is None:
+            continue
+        out.setdefault(mode, []).append(
+            (cs.module.relpath, cs.node.lineno, cs.scope))
+    return out
+
+
+def _fn_consults(index: PackageIndex, fi) -> bool:
+    for cs in index.calls_in_scope(fi):
+        parts = call_target_parts(cs.node)
+        name = parts[-1] if parts else None
+        if name == "maybe_kill":
+            return True
+        if name in _CONSULT_FNS and cs.node.args \
+                and isinstance(cs.node.args[0], ast.Constant) \
+                and isinstance(cs.node.args[0].value, str):
+            return True
+    return False
+
+
+def _reachable(index: PackageIndex, entry_fi) -> List:
+    """Functions reachable from ``entry_fi`` through resolved call
+    sites, with the same receiver-blind same-class step the thread
+    model uses."""
+    seen: Set[int] = {id(entry_fi.node)}
+    order = [entry_fi]
+    todo = [entry_fi]
+    while todo:
+        fi = todo.pop()
+        for cs in index.calls_in_scope(fi):
+            callee = cs.callee
+            if callee is None and isinstance(cs.node.func, ast.Attribute):
+                s, cls = fi, None
+                while s is not None and cls is None:
+                    cls = s.cls
+                    s = s.parent
+                if cls is not None:
+                    callee = index.methods.get(
+                        (cs.module.relpath, cls, cs.node.func.attr))
+            if callee is not None and id(callee.node) not in seen:
+                seen.add(id(callee.node))
+                order.append(callee)
+                todo.append(callee)
+    return order
+
+
+_KV_OPS = re.compile(r"^(blocking_)?key_value_|^kv_retry$")
+
+
+def _waiver_for(path: str, context: str) -> Optional[str]:
+    for suffix, ctx, reason in WAIVERS:
+        if path.endswith(suffix) and (context == ctx
+                                      or context.endswith("." + ctx)
+                                      or context.startswith(ctx)):
+            return reason
+    return None
+
+
+def _scan_tests(registry: Dict[str, str],
+                tests_dir: str) -> Dict[str, List[str]]:
+    """mode -> test files mentioning it as an installed fault: either
+    ``install("mode", ...)`` / ``wrap_kv_client`` fixtures or an
+    ``MXNET_TPU_CHAOS``-style env spec ``"mode:rank=..."``."""
+    out: Dict[str, List[str]] = {m: [] for m in registry}
+    if not os.path.isdir(tests_dir):
+        return out
+    pats = {m: re.compile(r"""['"]%s[:'"]""" % re.escape(m))
+            for m in registry}
+    for name in sorted(os.listdir(tests_dir)):
+        if not name.endswith(".py"):
+            continue
+        try:
+            with open(os.path.join(tests_dir, name),
+                      encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        for m, pat in pats.items():
+            if pat.search(src):
+                out[m].append("tests/" + name)
+    return out
+
+
+def audit(paths: Optional[Sequence[str]] = None,
+          root: Optional[str] = None,
+          tests_dir: Optional[str] = None) -> ChaosAudit:
+    root = root or _repo_root()
+    if paths is None:
+        paths = [os.path.join(root, "mxnet_tpu")]
+    if tests_dir is None:
+        tests_dir = os.path.join(root, "tests")
+    modules: List[ModuleInfo] = []
+    for path in collect_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                modules.append(ModuleInfo(path, rel, f.read()))
+        except (OSError, SyntaxError):
+            continue
+    index = PackageIndex(modules)
+    res = ChaosAudit()
+    res.registry = _load_registry(modules)
+    if not res.registry:
+        res.problems.append(
+            "no MODES registry found in parallel/chaos.py — the audit "
+            "has nothing to map fault points against")
+        return res
+
+    cons = _consultations(index)
+    res.consultations = {m: ["%s:%d" % (p, ln) for p, ln, _ in sites]
+                         for m, sites in sorted(cons.items())}
+    res.tests = _scan_tests(res.registry, tests_dir)
+
+    # -- registry <-> consultation <-> test closure ---------------------
+    for m in sorted(res.registry):
+        if m not in cons:
+            res.problems.append(
+                "mode '%s' is registered but no seam consults it "
+                "(should_fire/maybe_stall/active/maybe_kill)" % m)
+        if not res.tests.get(m):
+            res.problems.append(
+                "mode '%s' has no installing test under tests/" % m)
+    for m in sorted(cons):
+        if m not in res.registry:
+            res.problems.append(
+                "mode '%s' is consulted at %s but missing from the "
+                "MODES registry" % (m, res.consultations[m][0]))
+
+    # -- fault points ----------------------------------------------------
+    # 1. commit windows: every os.replace call — the crash instant the
+    #    atomic-write discipline exists for
+    for cs in index.call_sites:
+        if call_target_parts(cs.node)[-2:] != ("os", "replace"):
+            continue
+        ctx = cs.scope.qualname if cs.scope else "<module>"
+        fp = FaultPoint("commit-window", cs.module.relpath,
+                        cs.node.lineno, ctx)
+        if cs.scope is not None and _fn_consults(index, cs.scope):
+            fp.status = "covered"
+            fp.modes = tuple(sorted(
+                m for m, sites in cons.items()
+                if any(s is cs.scope for _, _, s in sites)))
+        else:
+            reason = _waiver_for(fp.path, ctx)
+            if reason:
+                fp.status, fp.note = "waived", reason
+        res.points.append(fp)
+
+    # 2. thread entries: each function a threading.Thread targets
+    entries = index.thread_entries()
+    entry_fis = [(nid, desc, index.by_node.get(nid))
+                 for nid, desc in sorted(entries.items(),
+                                         key=lambda kv: kv[1])]
+    covered_groups: Set[Tuple[str, Optional[str]]] = set()
+    pending = []
+    for nid, desc, fi in entry_fis:
+        if fi is None:
+            continue
+        path, _, line = desc.rpartition(":")
+        fp = FaultPoint("thread-entry", path, int(line), fi.qualname)
+        modes: Set[str] = set()
+        kv_seam = False
+        for rfi in _reachable(index, fi):
+            if _fn_consults(index, rfi):
+                for m, sites in cons.items():
+                    if any(s is rfi for _, _, s in sites):
+                        modes.add(m)
+            for cs in index.calls_in_scope(rfi):
+                parts = call_target_parts(cs.node)
+                if parts and _KV_OPS.search(parts[-1]):
+                    kv_seam = True
+        if kv_seam:
+            modes.update(m for m in ("kv_garble", "kv_stall")
+                         if m in res.registry)
+        if modes:
+            fp.status = "covered"
+            fp.modes = tuple(sorted(modes))
+            covered_groups.add((fi.module.relpath, fi.cls))
+        else:
+            reason = _waiver_for(fi.module.relpath, fi.qualname)
+            if reason:
+                fp.status, fp.note = "waived", reason
+        pending.append((fp, fi))
+    for fp, fi in pending:
+        if fp.status == "uncovered" \
+                and (fi.module.relpath, fi.cls) in covered_groups \
+                and fi.cls is not None:
+            # group rule: a sibling thread of the same object IS
+            # covered, and the chaos matrix perturbs the shared queues
+            # this thread drains (the serve batcher/watchdog case)
+            fp.status = "covered"
+            fp.note = "via sibling thread of %s" % fi.cls
+        res.points.append(fp)
+
+    # 3. KV coordinator ops behind kv_retry
+    for cs in index.call_sites:
+        name = call_target_name(cs.node)
+        if name != "kv_retry":
+            continue
+        if cs.module.relpath.endswith("parallel/elastic.py") \
+                and cs.scope is not None and cs.scope.name == "kv_retry":
+            continue
+        ctx = cs.scope.qualname if cs.scope else "<module>"
+        fp = FaultPoint("kv-op", cs.module.relpath, cs.node.lineno, ctx)
+        kv_modes = tuple(m for m in ("kv_garble", "kv_stall")
+                         if m in res.registry and m in cons)
+        if len(kv_modes) == 2:
+            fp.status = "covered"
+            fp.modes = kv_modes
+            fp.note = "via wrap_kv_client read proxy"
+        res.points.append(fp)
+
+    res.points.sort(key=lambda p: (p.path, p.line))
+    for p in res.points:
+        if p.status == "uncovered":
+            res.problems.append(
+                "%s at %s:%d (%s) has no chaos injection and no "
+                "waiver" % (p.kind, p.path, p.line, p.context))
+
+    # stale waivers must not rot: a waiver whose FILE is in the audited
+    # set must still match a fault point.  (A waiver for a file outside
+    # the audit — or deleted along with its fault point — is vacuous,
+    # not stale: the hazard it documented is gone with the site.)
+    matched = {p.note for p in res.points if p.status == "waived"}
+    present = {m.relpath for m in modules}
+    for suffix, ctx, reason in WAIVERS:
+        if not any(r.endswith(suffix) for r in present):
+            continue
+        if reason not in matched:
+            res.problems.append(
+                "stale waiver: no fault point matches %s (%s) — "
+                "delete the waiver" % (suffix, ctx))
+    return res
+
+
+def emit_telemetry(res: ChaosAudit) -> None:
+    try:
+        from mxnet_tpu import telemetry
+        telemetry.event(
+            "lint", "chaos_audit", ok=res.ok,
+            modes=len(res.registry), points=len(res.points),
+            problems=len(res.problems),
+            matrix=[[p.kind, "%s:%d" % (p.path, p.line),
+                     ",".join(p.modes) or p.status,
+                     ";".join(sorted(set(
+                         t for m in p.modes
+                         for t in res.tests.get(m, []))))]
+                    for p in res.points])
+    except Exception:
+        pass

@@ -146,9 +146,21 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> dict:
     entries = [{"file": k[0], "rule": k[1], "context": k[2], "count": n}
                for k, n in sorted(counts.items())]
     data = {"version": BASELINE_VERSION, "entries": entries}
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1, sort_keys=True)
-        f.write("\n")
+    # tmp + os.replace, hand-rolled: tools.lint must not import
+    # mxnet_tpu (fsutil.atomic_write_path), and the baseline is read by
+    # every gate run — it must never be observable half-written
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
     return data
 
 

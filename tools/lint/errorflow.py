@@ -1,0 +1,901 @@
+"""errorflow — exception-flow & resource-lifecycle analysis (phase 5).
+
+PRs 11-13 grew a failure-handling surface (atomic tmp+``os.replace``
+artifact writes, terminal-outcome request lifecycles, incident bundles)
+whose disciplines nothing *proved*; one new ``open(path, "w")`` or a
+swallowed exception in a thread loop silently reopens the torn-file and
+hung-request bug classes.  Five rules make those contracts
+machine-checked over the shared :class:`jitgraph.PackageIndex`:
+
+* ``err-swallowed-exception`` — a bare/broad ``except`` whose handler
+  neither re-raises, journals/logs, resolves a terminal outcome, nor
+  returns a fallback — scoped to where a silent swallow actually
+  deadlocks or corrupts: thread-reachable code (the PR-7 model) and
+  shutdown/cleanup paths (``close``/``stop``/...), plus every *bare*
+  ``except:``.  Allowlisted idioms: journal-and-continue in a daemon
+  loop (the handler calls ``telemetry``/``logging``), the
+  single-statement best-effort probe (``try: <one call> except: pass``)
+  and ``__del__`` finalizers (which must never raise).
+* ``res-nonatomic-write`` — a durable artifact written in place:
+  ``open(path, "w"/"wb")`` (or a direct ``np.savez``) on a non-tmp path
+  instead of the ``atomic_path``/``atomic_write_path`` tmp +
+  ``os.replace`` discipline.  Interprocedural: a helper that *returns*
+  a writable handle taints its call sites, a helper that *receives* the
+  target path is judged at each resolved call site, and the blessing of
+  a locally-defined atomic contextmanager is structural (it must
+  actually contain the ``os.replace`` commit — a copy with the commit
+  deleted is caught).  A tmp-named write with no reachable commit, and
+  a ``@contextmanager`` yielding a tmp path without ``os.replace``,
+  fire too.  Streaming writers (``self.fh = open(...)``, append mode)
+  are the allowlisted incremental-format idiom.
+* ``res-leaked-handle`` — a file/socket/temp-dir acquired into a local
+  without a ``with`` block or a ``finally``-reachable release: an
+  exception between acquire and the straight-line ``close()`` leaks
+  the handle.  Handles that escape (returned, stored on ``self``,
+  passed to another call) are the caller's to manage and clean.
+* ``err-terminal-outcome`` — dataflow over the first-write-wins
+  ``PendingRequest`` API: a request-carrying path that can exit its
+  scope with the request neither resolved (``_resolve``/reject/timeout/
+  error) nor handed off (passed on, stored, returned, appended).  Fires
+  only when *some* sibling path does resolve — the partial-resolution
+  signal behind every hung-request bug.
+* ``err-incident-trigger`` — a codepath journaling a terminal failure
+  event (``*_failed``/``*giveup``/``*quarantine``) without
+  ``flight_recorder.dump_incident`` reachable from the same function —
+  drift from the documented trigger matrix (docs/OBSERVABILITY.md).
+
+The runtime counterpart is ``tools.lint.chaos_coverage``: the same
+index enumerates the fault points these disciplines protect and audits
+that each one has a chaos injection and a covering test.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .concurrency import _SHUTDOWN_NAMES
+from .core import Finding, ModuleInfo
+from .jitgraph import PackageIndex, call_target_name, call_target_parts
+
+RULES = {
+    "err-swallowed-exception":
+        "bare/broad except that neither re-raises, journals, nor "
+        "resolves an outcome (thread loops & cleanup paths)",
+    "res-nonatomic-write":
+        "durable artifact written in place instead of the "
+        "atomic_path/tmp+os.replace discipline",
+    "res-leaked-handle":
+        "file/socket/temp-dir acquired without a with block or "
+        "finally-reachable release on exception edges",
+    "err-terminal-outcome":
+        "a PendingRequest-carrying path can exit without reaching a "
+        "terminal outcome (resolve/reject/timeout/error)",
+    "err-incident-trigger":
+        "journals a *_failed/giveup/quarantine event but never calls "
+        "flight_recorder.dump_incident",
+}
+
+_INTERESTING_TOKENS = ("except", "open(", "savez", "os.replace",
+                      "PendingRequest", "_resolve", "dump_incident",
+                      "mkdtemp", "socket(", "atomic")
+
+
+def _is_interesting(module: ModuleInfo) -> bool:
+    src = module.source
+    return any(tok in src for tok in _INTERESTING_TOKENS)
+
+
+def _parents(tree) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def _enclosing_function(index: PackageIndex, parents, node):
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return index.function_at(cur)
+        cur = parents.get(id(cur))
+    return None
+
+
+# -- err-swallowed-exception -------------------------------------------------
+
+_BROAD_TYPES = {"Exception", "BaseException"}
+# calls that make a handler "observed": journaling, logging, incident
+# dumps, terminal request outcomes, process exit
+_HANDLED_ROOTS = {"logging", "warnings", "telemetry", "_telemetry",
+                  "flight_recorder", "log", "logger", "_log", "LOG"}
+_HANDLED_ATTRS = {"exception", "warning", "warn", "error", "debug",
+                  "info", "critical", "log", "event", "inc", "journal",
+                  "dump_incident", "_exit", "print"}
+_TERMINAL_ATTRS = {"_resolve", "resolve", "reject", "set_result",
+                   "set_exception", "cancel"}
+# cleanup-path scope: the concurrency shutdown-name set minus __del__
+# (a finalizer that swallows is the CORRECT idiom — exceptions in
+# __del__ print interpreter noise and can fire mid-teardown)
+_CLEANUP_NAMES = frozenset(_SHUTDOWN_NAMES) - {"__del__"}
+_SIMPLE_STMTS = (ast.Expr, ast.Assign, ast.AugAssign, ast.Delete,
+                 ast.Import, ast.ImportFrom)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_TYPES
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD_TYPES
+                   for e in t.elts)
+    return False
+
+
+def _handler_observed(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return)):
+            return True
+        if isinstance(node, ast.Call):
+            parts = call_target_parts(node)
+            if not parts:
+                continue
+            if parts[0] in _HANDLED_ROOTS \
+                    or parts[-1] in _HANDLED_ATTRS \
+                    or parts[-1] in _TERMINAL_ATTRS:
+                return True
+    return False
+
+
+def _swallowed_findings(index: PackageIndex, module: ModuleInfo,
+                        parents) -> List[Finding]:
+    out: List[Finding] = []
+    reach = index.thread_reachable()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node) or _handler_observed(node):
+            continue
+        try_node = parents.get(id(node))
+        if isinstance(try_node, ast.Try) and len(try_node.body) == 1 \
+                and isinstance(try_node.body[0], _SIMPLE_STMTS):
+            # best-effort probe: try body is ONE simple statement whose
+            # failure the code explicitly rides out
+            continue
+        fi = _enclosing_function(index, parents, node)
+        if fi is not None and fi.name == "__del__":
+            continue
+        bare = node.type is None
+        in_thread = fi is not None and id(fi.node) in reach
+        in_cleanup = fi is not None and fi.name in _CLEANUP_NAMES
+        if not (bare or in_thread or in_cleanup):
+            continue
+        where = "thread loop" if in_thread else \
+            ("cleanup path" if in_cleanup else "handler")
+        out.append(Finding(
+            rule="err-swallowed-exception", path=module.relpath,
+            line=node.lineno, col=node.col_offset,
+            message="broad except in %s swallows the exception "
+                    "silently — re-raise, journal (telemetry.event/"
+                    "logging), or resolve an outcome" % where,
+            context=fi.qualname if fi else "<module>"))
+    return out
+
+
+# -- res-nonatomic-write -----------------------------------------------------
+
+_ATOMIC_CM_NAMES = {"atomic_path", "atomic_write_path"}
+_TEMPFILE_CTORS = {"mkdtemp", "mkstemp", "NamedTemporaryFile",
+                   "TemporaryDirectory", "TemporaryFile", "gettempdir",
+                   "mktemp"}
+
+
+def _has_os_replace(fn_node) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call) \
+                and call_target_parts(node)[-2:] == ("os", "replace"):
+            return True
+    return False
+
+
+def _is_contextmanager(fn_node) -> bool:
+    for dec in getattr(fn_node, "decorator_list", ()):
+        name = dec.attr if isinstance(dec, ast.Attribute) else \
+            (dec.id if isinstance(dec, ast.Name) else None)
+        if name in ("contextmanager", "asynccontextmanager"):
+            return True
+    return False
+
+
+class _WriteModel:
+    """Per-module bookkeeping for the atomic-write analysis."""
+
+    def __init__(self, index: PackageIndex, module: ModuleInfo, parents):
+        self.index = index
+        self.module = module
+        self.parents = parents
+        self.call_by_node = {id(cs.node): cs
+                             for cs in index.calls_in(module)}
+        # per-function: with-item bindings (name -> context call) and
+        # local assignments (name -> last value expr)
+        self.withmap: Dict[int, Dict[str, ast.Call]] = {}
+        self.assigns: Dict[int, Dict[str, ast.expr]] = {}
+        for fi in index.functions_in(module):
+            wm: Dict[str, ast.Call] = {}
+            am: Dict[str, ast.expr] = {}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        if isinstance(item.optional_vars, ast.Name) \
+                                and isinstance(item.context_expr,
+                                               ast.Call):
+                            wm[item.optional_vars.id] = \
+                                item.context_expr
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    am[node.targets[0].id] = node.value
+            self.withmap[id(fi.node)] = wm
+            self.assigns[id(fi.node)] = am
+
+    def blessed_cm(self, call: Optional[ast.Call]) -> bool:
+        """Is ``call`` an atomic-write contextmanager?  Resolved
+        helpers are checked STRUCTURALLY (the body must contain the
+        ``os.replace`` commit) so a copy with the commit deleted is not
+        blessed by its name; unresolved (imported) helpers are blessed
+        by name."""
+        if call is None:
+            return False
+        name = call_target_name(call)
+        if name not in _ATOMIC_CM_NAMES:
+            return False
+        cs = self.call_by_node.get(id(call))
+        callee = cs.callee if cs is not None else None
+        if callee is None:
+            return True
+        return _has_os_replace(callee.node)
+
+    def _names_in(self, expr) -> Set[str]:
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    def _strs_in(self, expr) -> List[str]:
+        return [n.value for n in ast.walk(expr)
+                if isinstance(n, ast.Constant)
+                and isinstance(n.value, str)]
+
+    def target_kind(self, expr, fi) -> str:
+        """Classify an open/savez target in function ``fi``:
+        ``blessed`` (bound from an atomic CM), ``tempfile`` (a true
+        temp path needing no commit), ``tmp`` (tmp-named: needs an
+        os.replace commit in scope), ``param`` (judged at call sites)
+        or ``plain``."""
+        wm = self.withmap.get(id(fi.node), {}) if fi else {}
+        am = self.assigns.get(id(fi.node), {}) if fi else {}
+        names = self._names_in(expr)
+        for n in names:
+            if self.blessed_cm(wm.get(n)):
+                return "blessed"
+        # one chase through local bindings: `target = d + "/x"` where
+        # `d = tempfile.mkdtemp()` is still a temp path
+        extended = set(names)
+        for n in names:
+            bound = am.get(n)
+            if bound is not None:
+                extended |= self._names_in(bound)
+        for n in extended:
+            bound = am.get(n)
+            if bound is not None and isinstance(bound, ast.Call):
+                parts = call_target_parts(bound)
+                if parts and (parts[0] == "tempfile"
+                              or parts[-1] in _TEMPFILE_CTORS):
+                    return "tempfile"
+        tmpish = any("tmp" in n.lower() for n in names) \
+            or any("tmp" in s for s in self._strs_in(expr))
+        if not tmpish and fi is not None:
+            # one chase through a local binding: tmp = "%s.tmp" % path
+            for n in names:
+                bound = am.get(n)
+                if bound is not None and any(
+                        "tmp" in s for s in self._strs_in(bound)):
+                    tmpish = True
+        if tmpish:
+            return "tmp"
+        if fi is not None and isinstance(expr, ast.Name) \
+                and expr.id in fi.param_names():
+            return "param"
+        return "plain"
+
+    def callsite_tmpish(self, expr, scope) -> bool:
+        names = self._names_in(expr)
+        if any("tmp" in n.lower() for n in names) \
+                or any("tmp" in s for s in self._strs_in(expr)):
+            return True
+        for n in names:
+            if scope is not None and self.blessed_cm(
+                    self.withmap.get(id(scope.node), {}).get(n)):
+                return True
+        return False
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    mode = None
+    if len(call.args) > 1:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _param_arg(call: ast.Call, callee, pname: str) -> Optional[ast.expr]:
+    names = callee.param_names()
+    if pname not in names:
+        return None
+    pos = names.index(pname)
+    if callee.is_method and names and names[0] in ("self", "cls"):
+        pos -= 1          # bound-method call sites omit self
+    if 0 <= pos < len(call.args):
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == pname:
+            return kw.value
+    return None
+
+
+def _nonatomic_findings(index: PackageIndex, module: ModuleInfo,
+                        parents) -> List[Finding]:
+    out: List[Finding] = []
+    model = _WriteModel(index, module, parents)
+    # helpers that RETURN writable handles taint their call sites
+    returns_handle: Set[int] = set()
+    for fi in index.functions_in(module):
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Call) \
+                    and call_target_name(node.value) == "open":
+                m = _open_mode(node.value)
+                if m and m[0] in "wx":
+                    returns_handle.add(id(fi.node))
+
+    def report(node, fi, msg):
+        out.append(Finding(
+            rule="res-nonatomic-write", path=module.relpath,
+            line=node.lineno, col=node.col_offset, message=msg,
+            context=fi.qualname if fi else "<module>"))
+
+    def judge_write(call, target, fi):
+        """One write of ``target`` inside ``fi`` — the shared decision
+        for direct opens, savez calls and handle-returning helpers."""
+        kind = model.target_kind(target, fi)
+        if kind in ("blessed", "tempfile"):
+            return
+        if kind == "tmp":
+            if fi is not None and _has_os_replace(fi.node):
+                return
+            report(call, fi,
+                   "tmp path written but never committed — no "
+                   "os.replace reachable in %s"
+                   % (fi.qualname if fi else "<module>"))
+            return
+        if kind == "param" and fi is not None:
+            sites = index._calls_by_callee.get(id(fi.node), ())
+            if sites:
+                for cs in sites:
+                    arg = _param_arg(cs.node, fi, target.id)
+                    if arg is None:
+                        continue
+                    if not model.callsite_tmpish(arg, cs.scope):
+                        out.append(Finding(
+                            rule="res-nonatomic-write",
+                            path=cs.module.relpath,
+                            line=cs.node.lineno,
+                            col=cs.node.col_offset,
+                            message="helper '%s' writes its argument "
+                                    "in place — pass a tmp path from "
+                                    "atomic_path/atomic_write_path"
+                                    % fi.name,
+                            context=(cs.scope.qualname if cs.scope
+                                     else "<module>")))
+                return
+        report(call, fi,
+               "durable artifact written in place — use "
+               "checkpoint.atomic_path / fsutil.atomic_write_path "
+               "(tmp + os.replace)")
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _is_contextmanager(node) \
+                and not _has_os_replace(node):
+            # an atomic-write CM that never commits: yields a tmp path
+            # the callers will write and nobody will publish
+            fi = index.function_at(node)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Yield) and sub.value is not None:
+                    kindfi = fi if fi is not None else None
+                    if kindfi is not None and model.target_kind(
+                            sub.value, kindfi) == "tmp":
+                        report(sub, fi,
+                               "contextmanager yields a tmp path but "
+                               "contains no os.replace commit")
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_target_name(node)
+        parts = call_target_parts(node)
+        fi = _enclosing_function(index, parents, node)
+        if name == "open":
+            mode = _open_mode(node)
+            if mode is None or not mode or mode[0] not in "wx":
+                continue
+            if not node.args:
+                continue
+            parent = parents.get(id(node))
+            if isinstance(parent, ast.Assign) and all(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in parent.targets):
+                # streaming-writer idiom: the handle lives on the
+                # object and the format is incremental by design
+                continue
+            if isinstance(parent, ast.Return):
+                # handle-returning helper: judged at its call sites
+                # through the returns_handle tracking below
+                continue
+            judge_write(node, node.args[0], fi)
+        elif parts and parts[-1] in ("savez", "savez_compressed") \
+                and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name) and fi is not None:
+                bound = model.assigns.get(id(fi.node), {}).get(target.id)
+                wm = model.withmap.get(id(fi.node), {})
+                if (isinstance(bound, ast.Call)
+                        and call_target_name(bound) == "open") \
+                        or target.id in wm:
+                    continue        # the open site governs the handle
+            judge_write(node, target, fi)
+        elif fi is not None:
+            cs = model.call_by_node.get(id(node))
+            if cs is not None and cs.callee is not None \
+                    and id(cs.callee.node) in returns_handle \
+                    and node.args:
+                judge_write(node, node.args[0], fi)
+    return out
+
+
+# -- res-leaked-handle -------------------------------------------------------
+
+_ACQUIRE_SOCKET = {"socket"}
+_RELEASE_ATTRS = {"close", "cleanup", "shutdown", "terminate",
+                  "unlink", "release"}
+
+
+def _acquisition_kind(call: ast.Call) -> Optional[str]:
+    name = call_target_name(call)
+    parts = call_target_parts(call)
+    if name == "open":
+        return "file handle"
+    if parts[-2:] == ("socket", "socket"):
+        return "socket"
+    if parts and parts[-1] in ("mkdtemp", "mkstemp") \
+            and (len(parts) == 1 or parts[0] == "tempfile"):
+        return "temp dir/file"
+    return None
+
+
+def _released_in_finally(fn_node, var: str) -> bool:
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        for sub in node.finalbody:
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Attribute) \
+                            and isinstance(f.value, ast.Name) \
+                            and f.value.id == var \
+                            and f.attr in _RELEASE_ATTRS:
+                        return True
+                    # shutil.rmtree(var) / os.rmdir(var) style
+                    if any(isinstance(a, ast.Name) and a.id == var
+                           for a in n.args):
+                        return True
+    return False
+
+
+def _escapes(fn_node, var: str, acquire: ast.Call) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name) and n.id == var:
+                    return True
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Name) and ctx.id == var:
+                    return True
+                if isinstance(ctx, ast.Call) and any(
+                        isinstance(a, ast.Name) and a.id == var
+                        for a in ctx.args):
+                    return True      # with closing(x) / with wrap(x)
+        if isinstance(node, ast.Call) and node is not acquire:
+            f = node.func
+            is_release = isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) and f.value.id == var
+            if not is_release and any(
+                    isinstance(a, ast.Name) and a.id == var
+                    for a in list(node.args)
+                    + [kw.value for kw in node.keywords]):
+                return True          # handed to another owner
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == var and all(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in node.targets):
+            return True              # stored on an object / registry
+    return False
+
+
+def _leak_findings(index: PackageIndex, module: ModuleInfo,
+                   parents) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _acquisition_kind(node)
+        if kind is None:
+            continue
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.withitem):
+            continue
+        if not isinstance(parent, ast.Assign):
+            continue                 # expression use: escapes or dies
+        if not (len(parent.targets) == 1
+                and isinstance(parent.targets[0], ast.Name)):
+            continue                 # attribute store / unpack: escapes
+        var = parent.targets[0].id
+        fi = _enclosing_function(index, parents, node)
+        if fi is None:
+            continue
+        if _released_in_finally(fi.node, var) \
+                or _escapes(fi.node, var, node):
+            continue
+        out.append(Finding(
+            rule="res-leaked-handle", path=module.relpath,
+            line=node.lineno, col=node.col_offset,
+            message="%s '%s' has no with block or finally-reachable "
+                    "release — an exception before close() leaks it"
+                    % (kind, var),
+            context=fi.qualname))
+    return out
+
+
+# -- err-terminal-outcome ----------------------------------------------------
+
+_REQ_TERMINAL = {"_resolve", "resolve", "reject", "set_result",
+                 "set_exception", "cancel", "fail"}
+
+
+class _OutcomeFlow:
+    """All-paths coverage of one request variable ``v`` over a
+    statement list: every path must perform a terminal/handoff action
+    on ``v`` or end in raise/continue/break.  ``if v.done()`` guards
+    and ``v is None`` null-guards exempt the corresponding branch.
+
+    States are path-sensitive: ``U`` (not yet assigned — a path may
+    exit freely), ``L`` (live and unresolved — exiting here is the
+    hung-request bug), ``C`` (covered by a terminal outcome or a
+    handoff)."""
+
+    def __init__(self, var: str):
+        self.var = var
+        self.any_action = False
+        self.endings: List[int] = []
+
+    # -- action predicates ------------------------------------------
+    def _action_in(self, node) -> bool:
+        found = False
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == self.var \
+                        and f.attr in _REQ_TERMINAL:
+                    found = True
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    tgt = a.value if isinstance(a, ast.Starred) else a
+                    if isinstance(tgt, ast.Name) and tgt.id == self.var:
+                        found = True
+            if isinstance(n, ast.Assign) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == self.var and all(
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in n.targets):
+                found = True
+        if found:
+            self.any_action = True
+        return found
+
+    def _test_guard(self, test) -> Optional[str]:
+        """'done' for a v.done() test, 'isnone'/'notnone' for null
+        guards, else None."""
+        node = test
+        neg = False
+        while isinstance(node, ast.UnaryOp) \
+                and isinstance(node.op, ast.Not):
+            neg = not neg
+            node = node.operand
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == self.var \
+                and node.func.attr == "done":
+            return "done"
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.left, ast.Name) \
+                and node.left.id == self.var \
+                and isinstance(node.comparators[0], ast.Constant) \
+                and node.comparators[0].value is None:
+            isnone = isinstance(node.ops[0], ast.Is)
+            if neg:
+                isnone = not isnone
+            return "isnone" if isnone else "notnone"
+        return None
+
+    def _is_birth(self, stmt) -> bool:
+        """``v = PendingRequest(...)`` — the point an unassigned var
+        becomes live."""
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == self.var
+                and isinstance(stmt.value, ast.Call)):
+            return False
+        parts = call_target_parts(stmt.value)
+        return bool(parts) and parts[-1] == "PendingRequest"
+
+    # -- CFG walk -----------------------------------------------------
+    def flow(self, stmts, states: Set[str]) -> Set[str]:
+        """Returns the states reaching the end of ``stmts`` (empty set:
+        no fall-through).  ``L``-state path ends are recorded in
+        ``self.endings``."""
+        for stmt in stmts:
+            if not states:
+                return states
+            if isinstance(stmt, (ast.Return,)):
+                covered = "L" not in states
+                if self._action_in(stmt):
+                    covered = True
+                if not covered and isinstance(stmt.value, ast.Name) \
+                        and stmt.value.id == self.var:
+                    covered = True   # hand the request back to caller
+                if not covered:
+                    self.endings.append(stmt.lineno)
+                return set()
+            if isinstance(stmt, (ast.Raise, ast.Continue, ast.Break)):
+                return set()
+            if self._is_birth(stmt):
+                states = {"L"}
+                continue
+            if isinstance(stmt, ast.If):
+                guard = self._test_guard(stmt.test)
+                if guard == "done":
+                    # whichever branch corresponds to done=True needs
+                    # nothing more; treat the whole If as satisfied —
+                    # but still record actions inside it (any_action
+                    # must see a sibling path that resolves)
+                    self._action_in(stmt)
+                    states = {"C"}
+                    continue
+                if self._action_in(stmt.test):
+                    states = {"C"}
+                then_in = states
+                else_in = states
+                if guard == "isnone":
+                    then_in = {"C"}      # v is None: nothing to resolve
+                elif guard == "notnone":
+                    else_in = {"C"}
+                t_out = self.flow(stmt.body, set(then_in))
+                e_out = self.flow(stmt.orelse, set(else_in)) \
+                    if stmt.orelse else set(else_in)
+                states = t_out | e_out
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if self._action_in(stmt.iter):
+                    states = {"C"}
+                body_out = self.flow(stmt.body, set(states))
+                if stmt.orelse:
+                    body_out |= self.flow(stmt.orelse, set(states))
+                states = states | body_out
+                continue
+            if isinstance(stmt, ast.While):
+                if self._action_in(stmt.test):
+                    states = {"C"}
+                body_out = self.flow(stmt.body, set(states))
+                states = states | body_out
+                continue
+            if isinstance(stmt, ast.Try):
+                b_out = self.flow(stmt.body, set(states))
+                h_out: Set[str] = set()
+                for h in stmt.handlers:
+                    if self._action_in(h):
+                        h_out |= {"C"}
+                        continue
+                    h_out |= self.flow(h.body, set(states))
+                o_out = self.flow(stmt.orelse, set(b_out)) \
+                    if stmt.orelse else b_out
+                merged = o_out | h_out
+                if stmt.finalbody:
+                    merged = self.flow(stmt.finalbody, set(merged))
+                states = merged
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if self._action_in(item.context_expr):
+                        states = {"C"}
+                states = self.flow(stmt.body, set(states))
+                continue
+            if self._action_in(stmt):
+                states = {"C"}
+        return states
+
+
+def _request_vars(fi) -> Dict[str, object]:
+    """{var: scope} — scope is the For node for loop vars, else the
+    function itself.  A var is request-bearing when a terminal-outcome
+    method OR the first-write-wins ``done()`` guard is called on it, or
+    it is assigned from a PendingRequest constructor.  Tracking via
+    ``done()`` matters for the seeded-bug class: a copy with the
+    resolve call DELETED still guards on ``done()`` and must be
+    caught."""
+    vars_: Dict[str, object] = {}
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and (node.func.attr in _REQ_TERMINAL
+                     or node.func.attr == "done"):
+            vars_.setdefault(node.func.value.id, None)
+        if isinstance(node, ast.Assign) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            parts = call_target_parts(node.value)
+            if parts and parts[-1] == "PendingRequest":
+                vars_.setdefault(node.targets[0].id, None)
+    # bind loop vars to their loops (innermost scope wins)
+    for node in ast.walk(fi.node):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            targets = [node.target] if isinstance(node.target, ast.Name) \
+                else [e for e in ast.walk(node.target)
+                      if isinstance(e, ast.Name)]
+            for t in targets:
+                if t.id in vars_:
+                    vars_[t.id] = node
+    return vars_
+
+
+def _terminal_findings(index: PackageIndex,
+                       module: ModuleInfo) -> List[Finding]:
+    if "PendingRequest" not in module.source:
+        return []
+    out: List[Finding] = []
+    for fi in index.functions_in(module):
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        for var, scope in _request_vars(fi).items():
+            flow = _OutcomeFlow(var)
+            if scope is not None:                    # loop var
+                body, anchor = scope.body, scope.lineno
+                ends = flow.flow(body, {"L"})
+            else:
+                # ctor-assigned locals start unassigned ("U"): a path
+                # that exits before the request exists owes nothing;
+                # params are live from entry
+                body, anchor = fi.node.body, fi.node.lineno
+                init = "L" if var in fi.param_names() else "U"
+                ends = flow.flow(body, {init})
+            if "L" in ends:
+                flow.endings.append(body[-1].lineno if body else anchor)
+            if flow.any_action and flow.endings:
+                out.append(Finding(
+                    rule="err-terminal-outcome", path=module.relpath,
+                    line=anchor, col=0,
+                    message="request '%s' can exit without a terminal "
+                            "outcome (resolve/reject/timeout/error) on "
+                            "a path ending near line %d"
+                            % (var, min(flow.endings)),
+                    context=fi.qualname))
+    return out
+
+
+# -- err-incident-trigger ----------------------------------------------------
+
+_FAILURE_EVENT = re.compile(r"(_failed|failed|giveup|give_up|"
+                            r"quarantine)$")
+
+
+def _dumps_incident(index: PackageIndex, fi, depth: int = 3) -> bool:
+    seen: Set[int] = set()
+    frontier = [fi]
+    for _ in range(depth):
+        nxt = []
+        for f in frontier:
+            if f is None or id(f.node) in seen:
+                continue
+            seen.add(id(f.node))
+            for node in ast.walk(f.node):
+                if isinstance(node, ast.Call) and \
+                        call_target_parts(node)[-1:] == \
+                        ("dump_incident",):
+                    return True
+            for cs in index.calls_in_scope(f):
+                if cs.callee is not None:
+                    nxt.append(cs.callee)
+        frontier = nxt
+        if not frontier:
+            break
+    return False
+
+
+def _incident_findings(index: PackageIndex,
+                       module: ModuleInfo) -> List[Finding]:
+    # the recorder itself journals its own dump_failed and must not
+    # recurse into another dump
+    for node in module.tree.body:
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "dump_incident":
+            return []
+    out: List[Finding] = []
+    for cs in index.calls_in(module):
+        parts = call_target_parts(cs.node)
+        if not parts or parts[-1] != "event":
+            continue
+        if len(cs.node.args) < 2:
+            continue
+        name = cs.node.args[1]
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)
+                and _FAILURE_EVENT.search(name.value)):
+            continue
+        if cs.scope is not None and _dumps_incident(index, cs.scope):
+            continue
+        out.append(Finding(
+            rule="err-incident-trigger", path=module.relpath,
+            line=cs.node.lineno, col=cs.node.col_offset,
+            message="journals terminal failure event '%s' but "
+                    "flight_recorder.dump_incident is unreachable — "
+                    "the incident-trigger matrix "
+                    "(docs/OBSERVABILITY.md) loses this postmortem"
+                    % name.value,
+            context=cs.scope.qualname if cs.scope else "<module>"))
+    return out
+
+
+# -- entry -------------------------------------------------------------------
+
+def check(module: ModuleInfo, index: PackageIndex) -> List[Finding]:
+    cached = getattr(index, "_errorflow_findings", None)
+    if cached is None:
+        cached = {}
+        for m in index.modules:
+            if not _is_interesting(m):
+                continue
+            parents = _parents(m.tree)
+            fs = (_swallowed_findings(index, m, parents)
+                  + _nonatomic_findings(index, m, parents)
+                  + _leak_findings(index, m, parents)
+                  + _terminal_findings(index, m)
+                  + _incident_findings(index, m))
+            for f in fs:
+                cached.setdefault(f.path, []).append(f)
+        index._errorflow_findings = cached
+    return list(cached.get(module.relpath, ()))

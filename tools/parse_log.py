@@ -161,6 +161,7 @@ def parse_jsonl(lines):
     serve = {"events": {}, "batches": 0, "fill_pct_sum": 0.0,
              "queue_depth_sum": 0, "wait_ms_sum": 0.0, "states": []}
     lint_gate = None
+    chaos_audit = None
     steps = 0
     for line in lines:
         line = line.strip()
@@ -316,6 +317,10 @@ def parse_jsonl(lines):
                                 "detail": rec.get("model")})
         elif kind == "lint" and rec.get("name") == "gate":
             lint_gate = rec
+        elif kind == "lint" and rec.get("name") == "chaos_audit":
+            # fault-injection coverage matrix (tools.lint --audit-chaos
+            # --telemetry): one row per fault point
+            chaos_audit = rec
         elif kind == "snapshot":
             counters.update(rec.get("counters", {}))
             gauges.update(rec.get("gauges", {}))
@@ -333,8 +338,8 @@ def parse_jsonl(lines):
             "lockorder": lockorder, "numerics": numerics,
             "autotune": autotune, "model": model, "program": program,
             "elastic": elastic, "serve": serve, "lint_gate": lint_gate,
-            "histograms": histograms, "traces": traces,
-            "incidents": incidents}
+            "chaos_audit": chaos_audit, "histograms": histograms,
+            "traces": traces, "incidents": incidents}
 
 
 def _render_hbm(hbm, fmt="markdown"):
@@ -408,8 +413,35 @@ def render_jsonl(agg, fmt="markdown"):
     out.extend(_render_histograms(agg.get("histograms") or {}, fmt))
     out.extend(_render_traces(agg.get("traces") or {}))
     out.extend(_render_incidents(agg.get("incidents") or [], fmt))
+    out.extend(_render_chaos_audit(agg.get("chaos_audit"), fmt))
     out.extend(_render_hbm(agg.get("hbm") or {}, fmt))
     return "\n".join(out)
+
+
+def _render_chaos_audit(rec, fmt="markdown"):
+    """Fault-injection coverage matrix from the lint/chaos_audit
+    telemetry event: fault point | injection | covering test."""
+    if not rec:
+        return []
+    out = ["", "chaos coverage (%s): %d mode(s), %d fault point(s), "
+           "%d problem(s)"
+           % ("OK" if rec.get("ok") else "FAILING",
+              rec.get("modes", 0), rec.get("points", 0),
+              rec.get("problems", 0))]
+    matrix = rec.get("matrix") or []
+    if not matrix:
+        return out
+    header = ["fault point", "site", "injection", "covering test"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+    for row in matrix:
+        kind, site, modes, tests = (list(row) + ["", "", "", ""])[:4]
+        vals = [str(kind), str(site), str(modes) or "-",
+                str(tests) or "-"]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
 
 
 def _render_histograms(histograms, fmt="markdown"):
@@ -764,7 +796,8 @@ def _render_numerics(numerics, fmt="markdown"):
 _RULE_FAMILIES = {"trace": "trace-safety", "retrace": "retrace",
                   "donate": "donation", "pallas": "pallas",
                   "shard": "sharding", "conc": "concurrency",
-                  "num": "numerics", "lint": "meta"}
+                  "num": "numerics", "err": "errorflow",
+                  "res": "errorflow", "lint": "meta"}
 
 
 def _rule_family(rule):

@@ -11,7 +11,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-from mxnet_tpu import recordio  # noqa: E402
+from mxnet_tpu import fsutil, recordio  # noqa: E402
 
 
 def main(argv=None):
@@ -31,19 +31,22 @@ def main(argv=None):
     reader = recordio.MXRecordIO(args.record, "r")
     n = 0
     try:
-        with open(idx_path, "w") as out:
-            while True:
-                off = reader.tell()
-                payload = reader.read()
-                if payload is None:
-                    break
-                if args.sequential:
-                    key = n
-                else:
-                    header, _ = recordio.unpack(payload)
-                    key = int(header.id)
-                out.write("%d\t%d\n" % (key, off))
-                n += 1
+        # atomic sidecar: a crash mid-scan must not leave a truncated
+        # .idx shadowing a complete .rec
+        with fsutil.atomic_write_path(idx_path) as tmp_idx:
+            with open(tmp_idx, "w") as out:
+                while True:
+                    off = reader.tell()
+                    payload = reader.read()
+                    if payload is None:
+                        break
+                    if args.sequential:
+                        key = n
+                    else:
+                        header, _ = recordio.unpack(payload)
+                        key = int(header.id)
+                    out.write("%d\t%d\n" % (key, off))
+                    n += 1
     finally:
         reader.close()
     print("wrote %d entries to %s" % (n, idx_path))
