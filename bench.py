@@ -763,6 +763,137 @@ def bench_zero_sharded_update(batch_size=256, hidden=2048, iters=8):
             "state_bytes_ok": n <= 1 or bytes_sh * (n - 1) < bytes_rep * n}
 
 
+def bench_grad_compression(batch_size=256, hidden=1024, iters=6,
+                           parity_steps=5):
+    """Compressed gradient collectives A/B (parallel/compression.py):
+    f32 vs int8 vs fp8 legs of the SAME sharded Adam train step over a
+    dp mesh spanning every local device, interleaved min-of-calls.
+    Records what MULTICHIP_r06 gates on — per-chip gradient wire bytes
+    (payload must drop exactly 4x vs f32; the per-chunk max-abs scale
+    side tensor is accounted separately and honestly), step time, and
+    the loss-parity deltas over the first ``parity_steps`` steps
+    (error-feedback quantization must track the f32 trajectory within
+    the per-mode band).  A final elastic 8->4 leg reshards the int8
+    leg's residual-carrying state and asserts the residuals migrated
+    BITWISE (byte movement only) and training still descends.
+
+    Gates (``_hard_failures``): ``compressed_ok: false`` — the wire
+    never engaged or the payload ratio came in under 4x — and
+    ``parity_ok: false`` — the compressed trajectory left the band —
+    both exit the bench nonzero.  On a 1-device mesh compression
+    disables by contract and the legs degenerate to the uncompressed
+    step (compressed_ok records the disablement as ok)."""
+    import time
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import ElasticContext
+    from mxnet_tpu.parallel import compression as comp
+    from mxnet_tpu.parallel.collectives import padded_size
+
+    n = len(jax.local_devices())
+    mesh = parallel.device_mesh((n,), ("dp",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # parity bands ~10x the measured dp=8 deltas at this probe scale
+    # (int8 ~8e-4, fp8 ~2e-4 over 5 steps): loose enough for backend
+    # jitter, tight enough that a broken dequantize or a dead
+    # error-feedback path blows through immediately
+    tol = {"int8": 1e-2, "fp8": 5e-3}
+
+    def leg(mode):
+        onp.random.seed(7)
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden, activation="relu"),
+                nn.Dense(hidden // 2, activation="relu"), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(batch_size, 123).astype("float32"))
+        y = mx.nd.array(
+            onp.random.randint(0, 10, (batch_size,)).astype("float32"))
+        net(x)
+        step = parallel.DataParallelStep(
+            net, lambda o, l: loss_fn(o, l),
+            mx.optimizer.Adam(learning_rate=1e-3), mesh=mesh,
+            shard_optimizer=True, grad_compression=mode)
+        losses = [float(step(x, y).asscalar())
+                  for _ in range(parity_steps)]
+        return step, (x, y), losses
+
+    modes = (None, "int8", "fp8")
+    legs = {m: leg(m) for m in modes}
+    ms = {m: None for m in modes}
+    for _ in range(iters):
+        for m in modes:
+            step, b, _ = legs[m]
+            t0 = time.perf_counter()
+            step(*b).asnumpy()
+            d = (time.perf_counter() - t0) * 1e3
+            ms[m] = d if ms[m] is None else min(ms[m], d)
+
+    # wire arithmetic over the flat zero-padded sharded layout — the
+    # same schedule accounting _report_shard_layout journals
+    step0 = legs[None][0]
+    padded = sum(padded_size(int(onp.prod(step0._shard_meta[s])), n)
+                 for s in range(len(step0._opt_states))
+                 if step0._shard_slots[s]) if n > 1 else 0
+    base_losses = legs[None][2]
+    out_legs = [{"mode": "f32", "step_ms": round(ms[None], 3),
+                 "grad_wire_bytes_per_chip": comp.wire_bytes(padded),
+                 "scale_bytes_per_chip": 0,
+                 "losses": [round(v, 6) for v in base_losses]}]
+    for m in ("int8", "fp8"):
+        step = legs[m][0]
+        engaged = step._compress == m
+        wire = comp.wire_bytes(padded, m)
+        scale = comp.scale_bytes(padded, m)
+        ratio = comp.wire_bytes(padded) / float(wire) if wire else 1.0
+        delta = max(abs(a - b)
+                    for a, b in zip(base_losses, legs[m][2]))
+        out_legs.append({
+            "mode": m, "step_ms": round(ms[m], 3),
+            "grad_wire_bytes_per_chip": wire,
+            "scale_bytes_per_chip": scale,
+            "wire_ratio": round(ratio, 3),
+            "parity_max_abs": round(delta, 6), "parity_tol": tol[m],
+            "losses": [round(v, 6) for v in legs[m][2]],
+            "engaged": engaged,
+            "parity_ok": delta <= tol[m],
+            "compressed_ok": n <= 1 or (engaged and ratio >= 4.0)})
+
+    # elastic 8->4: the int8 leg's residual-carrying state re-shards;
+    # residuals are the LAST state leaf per slot and must migrate
+    # bitwise (reshard is byte movement, never arithmetic)
+    reshard = None
+    if n > 1 and legs["int8"][0]._compress == "int8":
+        st = legs["int8"][0]
+        res_before = [st._materialize_slot(s)[-1].copy()
+                      for s in range(len(st._opt_states))]
+        half = max(1, n // 2)
+        ElasticContext(st, liveness=lambda: 0).reform(
+            devices=jax.devices()[:half])
+        bitwise = all(
+            onp.array_equal(b, st._materialize_slot(s)[-1])
+            for s, b in enumerate(res_before))
+        after = float(st(*legs["int8"][1]).asscalar())
+        parallel.set_mesh(mesh)
+        reshard = {"world_from": n, "world_to": half,
+                   "residual_bitwise_ok": bitwise,
+                   "loss_finite_after": bool(onp.isfinite(after)),
+                   "still_compressed": st._compress == "int8"}
+
+    return {"bench": "grad_compression", "batch_size": batch_size,
+            "hidden": hidden, "n_shards": n, "padded_params": padded,
+            "legs": out_legs, "reshard": reshard,
+            "compressed_ok": all(l.get("compressed_ok", True)
+                                 for l in out_legs)
+            and (reshard is None
+                 or (reshard["residual_bitwise_ok"]
+                     and reshard["loss_finite_after"])),
+            "parity_ok": all(l.get("parity_ok", True) for l in out_legs)}
+
+
 def bench_checkpoint_overhead(batch_size=256, hidden=512, iters=8,
                               every=32):
     """A/B of the SAME compiled MLP train step with async checkpointing
@@ -1448,6 +1579,63 @@ def r06_artifact(out_path):
         sys.exit(3)
 
 
+def multichip_r06_artifact(out_path):
+    """Cut MULTICHIP_r06: the compressed-collectives round.  One leg —
+    the interleaved f32 / int8 / fp8 A/B of the sharded train step at
+    dp = every local device (``bench_grad_compression``: bytes/chip,
+    step ms, loss-parity deltas, and the elastic 8->4 reshard of the
+    residual-carrying state) — plus the run's telemetry snapshot
+    (compress/decision journal + compression gauges), wrapped in the
+    BENCH_rNN series' outer format with the multichip header.  Any
+    ``compressed_ok: false`` or parity breach is a HARD failure
+    (exit 3): a wire that silently never narrowed, or one that
+    narrowed by breaking the numerics, must never ship."""
+    import jax
+    from mxnet_tpu import telemetry
+
+    details = []
+    try:
+        details.append(bench_grad_compression())
+    except Exception as e:
+        details.append({"bench": "grad_compression", "error": repr(e),
+                        "compressed_ok": False})
+    tsnap = telemetry.snapshot(events=256)
+    details.append({
+        "bench": "telemetry_snapshot",
+        "counters": {k: v for k, v in tsnap["counters"].items()
+                     if k.startswith(("zero.", "donation."))},
+        "gauges": {k: v for k, v in tsnap["gauges"].items()
+                   if k.startswith(("compression.", "parallel."))},
+        "compress_decisions": [
+            e for e in tsnap.get("events", [])
+            if e.get("kind") == "compress"]})
+    print("# %s" % json.dumps(details[0])[:2000], file=sys.stderr)
+    gc = details[0]
+    hard = _hard_failures(details)
+    int8_leg = next((l for l in (gc.get("legs") or [])
+                     if l.get("mode") == "int8"), {})
+    inner = {"metric": "grad_wire_ratio_int8",
+             "value": int8_leg.get("wire_ratio"), "unit": "x",
+             "vs_baseline": int8_leg.get("parity_max_abs"),
+             "detail": details}
+    if hard:
+        inner["hard_failures"] = hard
+    summary = {k: v for k, v in inner.items() if k != "detail"}
+    from mxnet_tpu.fsutil import atomic_write_path
+    with atomic_write_path(out_path) as tmp_out:
+        with open(tmp_out, "w") as f:
+            json.dump({"n": 6, "n_devices": len(jax.local_devices()),
+                       "cmd": "python bench.py --multichip-r06",
+                       "rc": 3 if hard else 0, "ok": not hard,
+                       "tail": json.dumps(summary),
+                       "parsed": inner}, f, indent=1)
+    print(json.dumps(summary))
+    for h in hard:
+        print("# HARD FAIL: %s" % h, file=sys.stderr)
+    if hard:
+        sys.exit(3)
+
+
 def smoke():
     """Seconds-scale sanity run (CPU-safe): tiny net, tiny batch."""
     import numpy as onp
@@ -1528,6 +1716,12 @@ def main():
                          "schedule A/Bs, ZeRO/donation composition, "
                          "table census) and cut the BENCH_r06 artifact")
     ap.add_argument("--r06-out", default="BENCH_r06.json")
+    ap.add_argument("--multichip-r06", action="store_true",
+                    help="run just the compressed-collectives A/B "
+                         "(f32/int8/fp8 sharded step + elastic reshard "
+                         "of residual state) and cut the MULTICHIP_r06 "
+                         "artifact")
+    ap.add_argument("--multichip-r06-out", default="MULTICHIP_r06.json")
     args = ap.parse_args()
 
     if args.smoke:
@@ -1541,6 +1735,9 @@ def main():
         return
     if args.r06:
         r06_artifact(args.r06_out)
+        return
+    if args.multichip_r06:
+        multichip_r06_artifact(args.multichip_r06_out)
         return
 
     jobs = []
@@ -1576,6 +1773,8 @@ def main():
             iters=max(6, args.iters // 2)))
         jobs.append(lambda: bench_zero_sharded_update(
             iters=max(4, args.iters // 3)))
+        jobs.append(lambda: bench_grad_compression(
+            iters=max(3, args.iters // 4)))
         jobs.append(lambda: bench_checkpoint_overhead(
             iters=max(4, args.iters // 3)))
         # autotuner v2: program-schedule A/Bs + the autotuner x ZeRO x
@@ -1653,6 +1852,13 @@ def main():
         # all local devices; n_shards=1 degenerates gracefully)
         jobs.append(lambda: bench_zero_sharded_update(
             iters=max(4, it // 3)))
+        # compressed gradient collectives A/B (f32/int8/fp8 sharded
+        # step): wire bytes must narrow 4x with loss parity held, and
+        # the residual-carrying state must survive an elastic reshard
+        # bitwise — compressed_ok/parity_ok are hard gates; the
+        # standalone MULTICHIP_r06 artifact cuts from the same leg
+        jobs.append(lambda: bench_grad_compression(
+            iters=max(3, it // 4)))
         # async checkpointing must stay <= 2% on the hot step at the
         # default cadence (hard gate, mirroring the telemetry gate)
         jobs.append(lambda: bench_checkpoint_overhead(
@@ -1773,7 +1979,14 @@ def _hard_failures(details):
         budget was measured against a dead path;
       * ``checkpoint_overhead`` > 2% — async checkpointing at the
         default cadence must be effectively free on the hot step, or
-        nobody leaves durability on in production.
+        nobody leaves durability on in production;
+      * ``grad_compression`` ``compressed_ok: false`` — a compressed
+        leg's wire never engaged, its payload ratio came in under the
+        4x contract, or the residual-carrying state failed the elastic
+        reshard bitwise check — and ``parity_ok: false`` — the int8/
+        fp8 trajectory left the loss-parity band vs the uncompressed
+        sharded step: a wire that saves bytes by corrupting gradients
+        must never cut an artifact.
     """
     hard = []
     for d in details:
@@ -1865,6 +2078,40 @@ def _hard_failures(details):
                     d.get("shard_tuned"), d.get("zero_source"),
                     d.get("step_ms_tuned", 0),
                     d.get("step_ms_heuristic", 0)))
+        if d.get("bench") == "grad_compression":
+            if d.get("error"):
+                hard.append("grad_compression leg crashed: %s"
+                            % d["error"])
+            if d.get("compressed_ok") is False:
+                bad = [l for l in (d.get("legs") or [])
+                       if l.get("compressed_ok") is False]
+                rs = d.get("reshard") or {}
+                for l in bad:
+                    hard.append(
+                        "grad compression %s: engaged=%s wire_ratio=%s "
+                        "< 4.0 at dp=%s — the compressed wire contract "
+                        "failed" % (l.get("mode"), l.get("engaged"),
+                                    l.get("wire_ratio"),
+                                    d.get("n_shards")))
+                if rs and not (rs.get("residual_bitwise_ok")
+                               and rs.get("loss_finite_after")):
+                    hard.append(
+                        "grad compression elastic %s->%s reshard: "
+                        "residual_bitwise_ok=%s loss_finite_after=%s — "
+                        "error-feedback state must migrate bitwise and "
+                        "keep training" % (
+                            rs.get("world_from"), rs.get("world_to"),
+                            rs.get("residual_bitwise_ok"),
+                            rs.get("loss_finite_after")))
+            if d.get("parity_ok") is False:
+                for l in (d.get("legs") or []):
+                    if l.get("parity_ok") is False:
+                        hard.append(
+                            "grad compression %s loss parity breach: "
+                            "max |dloss| %s > tol %s vs the "
+                            "uncompressed sharded step" % (
+                                l.get("mode"), l.get("parity_max_abs"),
+                                l.get("parity_tol")))
         if d.get("bench") == "autotune_census":
             rs = d.get("ranked_search")
             if rs is not None and rs.get("fewer_than_v1") is False:

@@ -51,7 +51,8 @@ class _FusedUpdate:
     row-sparse — the caller then runs the eager per-parameter loop.
     """
 
-    def __init__(self, updater, donate_grads=False, shard_optimizer=False):
+    def __init__(self, updater, donate_grads=False, shard_optimizer=False,
+                 grad_compression=None):
         self._updater = updater
         self._donate_grads = donate_grads
         self._cache = {}
@@ -76,6 +77,25 @@ class _FusedUpdate:
         self._shard_mesh = None
         self._shard_n = 0
         self._shard_skip_reported = False
+        # Compressed gradient wire for the sharded leg (see
+        # parallel/compression.py): the knob is validated eagerly, the
+        # MODE resolves once sharding engages (_shard_ready) — the dp
+        # extent and the prog_compress cost-table key need the live
+        # mesh.  Error-feedback residuals ride as one extra flat leaf
+        # at the END of each index's sharded mirror; they are
+        # mirror-only (materialize_states' zip-shortest drops them, so
+        # Trainer.save_states never sees them — a restore simply
+        # restarts error feedback from zero, which is numerics-safe).
+        from ..parallel.compression import MODES as _CMODES
+        if grad_compression in (None, False, "", 0, "0", "off"):
+            grad_compression = None
+        elif grad_compression not in _CMODES + ("auto",):
+            raise ValueError(
+                "grad_compression must be one of %s, None or 'auto', "
+                "got %r" % (_CMODES, grad_compression))
+        self._compress_knob = grad_compression
+        self._compress = ""
+        self._compress_decided = False
 
     def __getstate__(self):
         # the jitted executables are not picklable (and are cheap to
@@ -91,6 +111,10 @@ class _FusedUpdate:
         state["_sharded"] = {}
         state["_shard_mesh"] = None
         state["_shard_n"] = 0
+        # mesh-dependent: re-resolved (and re-journaled) when sharding
+        # re-engages on the unpickled trainer
+        state["_compress"] = ""
+        state["_compress_decided"] = False
         return state
 
     # -- ZeRO sharded-state mirror --------------------------------------
@@ -156,24 +180,98 @@ class _FusedUpdate:
                 return False
         self._shard_mesh = mesh
         self._shard_n = int(mesh.shape["dp"])
+        if not self._compress_decided:
+            self._compress_decided = True
+            self._compress = self._resolve_compress(weights)
         return True
+
+    def _resolve_compress(self, weights):
+        """Resolve the ``grad_compression`` knob against the live dp
+        extent — mirrors ``DataParallelStep._resolve_grad_compression``
+        (same journal record, same "auto" cost-table key) but sized
+        from the trainer's weight list."""
+        knob = self._compress_knob
+        if not knob:
+            return ""
+        if self._shard_n < 2:
+            # the 1-device degenerate sharded layout has no gradient
+            # wire to narrow — quietly disable, journal why (mirrors
+            # DataParallelStep's layout disable)
+            telemetry.event(
+                "compress", "decision", mode="off", requested=str(knob),
+                path="disabled", tuner_source="layout",
+                dp=int(self._shard_n), params=0, dtype="float32",
+                wire_bytes=0, scale_bytes=0, f32_bytes=0, ratio=1.0)
+            return ""
+        try:
+            pcount = sum(int(onp.prod(w.shape)) for w in weights)
+            dtype = str(onp.dtype(weights[0].dtype)) if weights \
+                else "float32"
+        except Exception:
+            pcount, dtype = 0, "float32"
+        if knob == "auto":
+            # compression changes numerics: "auto" engages only on a
+            # MEASURED prog_compress entry (bench A/B or offline
+            # search), never by heuristic
+            mode, path, src = "", "heuristic", "heuristic"
+            if pcount > 0:
+                try:
+                    from ..tune import program as _prog
+                    cfg = _prog.program_config(
+                        "prog_compress",
+                        (_prog.canon_param_count(pcount),
+                         self._shard_n), dtype=dtype)
+                except Exception:
+                    cfg = None
+                if cfg is not None:
+                    from ..tune.program import MODE_CODES
+                    mode = MODE_CODES[int(cfg["mode"])]
+                    path, src = "measured", cfg.get("source", "table")
+        else:
+            mode, path, src = knob, "forced", "arg"
+        from ..parallel import compression as _comp
+        base = _comp.wire_bytes(pcount, None)
+        wire = _comp.wire_bytes(pcount, mode or None)
+        scale = _comp.scale_bytes(pcount, mode or None)
+        telemetry.gauge("compression.bytes_saved",
+                        max(0, base - wire - scale))
+        telemetry.gauge("compression.scale_bytes", scale)
+        telemetry.event(
+            "compress", "decision", mode=mode or "off",
+            requested=str(knob), path=path, tuner_source=src,
+            dp=int(self._shard_n), params=int(pcount), dtype=dtype,
+            wire_bytes=int(wire), scale_bytes=int(scale),
+            f32_bytes=int(base),
+            ratio=round(base / float(wire), 3) if wire else 1.0)
+        return mode
 
     def _shard_sharding(self, replicated=False):
         import jax.sharding as jsh
         spec = jsh.PartitionSpec() if replicated else jsh.PartitionSpec("dp")
         return jsh.NamedSharding(self._shard_mesh, spec)
 
-    def _sharded_leaves(self, i, leaves):
+    def _sharded_leaves(self, i, leaves, weight):
         """The flat dp-sharded mirror of index ``i``'s state leaves
-        (built from the updater's natural-shape leaves on first use)."""
+        (built from the updater's natural-shape leaves on first use).
+        Under grad compression one extra leaf — the zero-initialized
+        error-feedback residual, flat padded like the weight — is
+        appended LAST; it has no natural-shape shell in the updater
+        (mirror-only, see ``__init__``)."""
         import jax
-        from ..parallel.collectives import flatten_pad
+        import jax.numpy as jnp
+        from ..parallel.collectives import flatten_pad, padded_size
         got = self._sharded.get(i)
         if got is not None:
             return got
         spec = self._shard_sharding()
         flat = [jax.device_put(flatten_pad(l._data, self._shard_n), spec)
                 for l in leaves]
+        if self._compress:
+            mp = self._updater.optimizer.multi_precision \
+                and onp.dtype(weight.dtype).itemsize < 4
+            rdt = jnp.float32 if mp else weight.dtype
+            n = padded_size(int(onp.prod(weight.shape)), self._shard_n)
+            flat.append(jax.device_put(jnp.zeros((n,), rdt), spec))
         self._sharded[i] = flat
         return flat
 
@@ -190,6 +288,10 @@ class _FusedUpdate:
             shells, _ = jax.tree_util.tree_flatten(
                 self._updater.states[i], is_leaf=is_nd)
             with autograd.pause():
+                # zip-shortest: the compressed mirror carries one extra
+                # trailing leaf (the error-feedback residual) with no
+                # natural-shape shell — it stays mirror-only and is
+                # deliberately NOT serialized
                 for shell, fl in zip(shells, flat):
                     shell._data = unflatten(fl, shell.shape)
 
@@ -209,6 +311,13 @@ class _FusedUpdate:
         self._shard_mesh = None
         self._shard_n = 0
         self._shard_skip_reported = False
+        # compression re-resolves at the NEW dp extent (the "auto"
+        # cost-table key and the journaled wire arithmetic both depend
+        # on it); residuals restart from zero — numerics-safe, the
+        # error-feedback carry is a convergence refinement, not state
+        # correctness
+        self._compress = ""
+        self._compress_decided = False
         self._cache.clear()
 
     def __call__(self, indices, grads, weights):
@@ -261,7 +370,8 @@ class _FusedUpdate:
         key = (tuple(indices), fingerprint,
                tuple(optimizer._get_wds(list(indices))),
                tuple((w.shape, str(w.dtype)) for w in weights),
-               self._shard_n if sharded else 0)
+               self._shard_n if sharded else 0,
+               self._compress if sharded else "")
         jfn = self._cache.get(key)
         if jfn is None:
             telemetry.record_compile(
@@ -283,6 +393,7 @@ class _FusedUpdate:
                 REPL = self._shard_sharding(replicated=True)
                 shard_n = self._shard_n
                 wshapes = [tuple(w.shape) for w in weights]
+                compress = self._compress or None
 
             def fused(wvals, gvals, svals, t, lr_vec):
                 new_w, new_s = [], []
@@ -304,7 +415,7 @@ class _FusedUpdate:
                             step, wvals[k], gvals[k], svals[k], t,
                             lr_vec[k], shape=wshapes[k],
                             mp=mp_flags[k], axis_size=shard_n,
-                            shard=SHARD, repl=REPL)
+                            shard=SHARD, repl=REPL, compress=compress)
                         new_w.append(nw)
                         new_s.append(ns)
                         continue
@@ -347,8 +458,8 @@ class _FusedUpdate:
         wvals = [w._data for w in weights]
         gvals = [g._data for g in grads]
         if sharded:
-            svals = [self._sharded_leaves(i, lv)
-                     for i, lv in zip(indices, leaves_per)]
+            svals = [self._sharded_leaves(i, lv, w)
+                     for i, lv, w in zip(indices, leaves_per, weights)]
             telemetry.gauge(
                 "trainer.optimizer_state_bytes_per_chip",
                 sum(int(l.nbytes) // self._shard_n
@@ -393,11 +504,21 @@ class Trainer:
         after ``step()`` the old gradient buffers are consumed, so the
         caller must not read ``param.grad()`` until the next
         ``backward()`` rebinds them.
+    grad_compression : {"int8", "fp8", "auto", None}, default None —
+        narrow the ZeRO gradient wire when ``shard_optimizer`` engages
+        (``parallel/compression.py``: per-chunk symmetric quantization
+        with error-feedback residuals carried as an extra dp-sharded
+        mirror leaf).  ``"auto"`` consults the ``prog_compress`` cost
+        table at the engage point; without the sharded update the knob
+        is inert (there is no gradient reduce-scatter to narrow).
+        Distinct from ``compression_params`` (the reference kvstore
+        2-bit push/pull compression API).
     """
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None,
-                 donate_grads=False, shard_optimizer=False):
+                 donate_grads=False, shard_optimizer=False,
+                 grad_compression=None):
         param_list = []
         if isinstance(params, (dict, ParameterDict)):
             for key in sorted(list(params.keys())):
@@ -429,6 +550,7 @@ class Trainer:
         self._params_to_init = []
         self._donate_grads = donate_grads
         self._shard_optimizer = shard_optimizer
+        self._grad_compression = grad_compression
         self._kv_fused = None
         self._local_fused = None
         self._step_count = 0
@@ -584,7 +706,8 @@ class Trainer:
         if self._kv_fused is None or self._kv_fused._updater is not store._updater:
             self._kv_fused = _FusedUpdate(
                 store._updater, donate_grads=self._donate_grads,
-                shard_optimizer=self._shard_optimizer)
+                shard_optimizer=self._shard_optimizer,
+                grad_compression=self._grad_compression)
         indices, grads, weights = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -633,7 +756,8 @@ class Trainer:
                 self._local_fused._updater is not self._updaters:
             self._local_fused = _FusedUpdate(
                 self._updaters, donate_grads=self._donate_grads,
-                shard_optimizer=self._shard_optimizer)
+                shard_optimizer=self._shard_optimizer,
+                grad_compression=self._grad_compression)
         indices, grads, weights = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":

@@ -1,73 +1,23 @@
-"""2-bit gradient compression with error-feedback residual.
+"""Deprecation shim: 2-bit kvstore gradient compression moved to
+``mxnet_tpu.parallel.compression``.
 
-Parity target: the reference's ``GradientCompression``
-(``src/kvstore/gradient_compression.h:38-132``, kernels
-``gradient_compression-inl.h``): each element of (grad + residual) is
-quantized to one of {-threshold, 0, +threshold}; the quantization error is
-kept in a per-key residual and added to the next gradient, so nothing is
-lost systematically.  Codes pack 16 elements per uint32 (2 bits each) —
-a 16x wire-size reduction for float32 gradients.
-
-TPU-native design: the quantize/dequantize kernels are pure jnp functions
-(jit-able, fusable into the train step).  On-ICI all-reduce is not
-bandwidth-bound, so compression matters for the DCN/multi-host hop — the
-KVStore applies it around the cross-replica reduction when configured via
-``set_gradient_compression({'type': '2bit', 'threshold': t})`` exactly like
-the reference's dist push path (``kvstore_dist.h:361``).
+The jnp-pure quantize/pack kernels (reference
+``src/kvstore/gradient_compression.h:38-132`` parity) now live next to
+the int8/fp8 ZeRO-wire compression they share error-feedback lineage
+with — import them from ``mxnet_tpu.parallel.compression``.  This
+module keeps the old import path (kvstore's dist push path and existing
+user code) working; the stateful per-key :class:`GradientCompression`
+driver stays here because it is kvstore API surface, not wire math.
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
-import numpy as onp
-
 from .base import MXNetError
+# re-exported for the kvstore dist path and legacy importers
+from .parallel.compression import (quantize_2bit, dequantize_2bit,  # noqa: F401
+                                   pack_2bit, unpack_2bit)
 
 __all__ = ["GradientCompression", "quantize_2bit", "dequantize_2bit",
            "pack_2bit", "unpack_2bit"]
-
-
-def quantize_2bit(data, residual, threshold):
-    """Quantize (data + residual) to {-t, 0, +t}; return (q, new_residual).
-
-    ``q`` is the dequantized value actually transmitted; ``new_residual``
-    carries the error forward (reference gradient_compression-inl.h
-    quantize_2bit kernel semantics)."""
-    d = data + residual
-    q = jnp.where(d >= threshold, threshold,
-                  jnp.where(d <= -threshold, -threshold, 0.0))
-    return q, d - q
-
-
-def dequantize_2bit(q, threshold):
-    """Identity on already-dequantized values (kept for API symmetry)."""
-    return q
-
-
-def pack_2bit(q, threshold):
-    """Pack quantized values into the 2-bit wire format: uint32 words,
-    16 codes each (code 0 → 0, 1 → +t, 2 → -t).  Returns (packed uint32
-    array, original size)."""
-    flat = jnp.ravel(q)
-    n = flat.shape[0]
-    codes = jnp.where(flat > 0, 1, jnp.where(flat < 0, 2, 0)).astype(
-        jnp.uint32)
-    pad = (-n) % 16
-    codes = jnp.concatenate(
-        [codes, jnp.zeros((pad,), jnp.uint32)]) if pad else codes
-    codes = codes.reshape(-1, 16)
-    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
-    packed = jnp.bitwise_or.reduce(codes << shifts, axis=1)
-    return packed, n
-
-
-def unpack_2bit(packed, n, threshold, shape=None):
-    """Inverse of :func:`pack_2bit` → float32 values in {-t, 0, +t}."""
-    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
-    codes = (packed[:, None] >> shifts) & jnp.uint32(3)
-    flat = codes.reshape(-1)[:n]
-    out = jnp.where(flat == 1, threshold,
-                    jnp.where(flat == 2, -threshold, 0.0)).astype(jnp.float32)
-    return out.reshape(shape) if shape is not None else out
 
 
 class GradientCompression:
@@ -97,6 +47,7 @@ class GradientCompression:
 
     def compress(self, key, grad):
         """Error-feedback quantize one gradient array (jnp in/out)."""
+        import jax.numpy as jnp
         r = self._residuals.get(key)
         if r is None or getattr(r, "shape", None) != grad.shape:
             r = jnp.zeros_like(grad)
@@ -110,12 +61,3 @@ class GradientCompression:
 
     def reset(self):
         self._residuals.clear()
-
-
-def _self_test():  # pragma: no cover - debugging aid
-    rs = onp.random.RandomState(0)
-    g = jnp.asarray(rs.randn(100).astype("float32"))
-    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
-    q = gc.compress("k", g)
-    packed, n = pack_2bit(q, 0.5)
-    assert bool(jnp.array_equal(unpack_2bit(packed, n, 0.5), q))

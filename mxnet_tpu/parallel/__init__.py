@@ -39,6 +39,7 @@ from .collectives import (  # noqa: F401
 from .data_parallel import DataParallelStep  # noqa: F401
 from .elastic import ElasticContext, kv_retry  # noqa: F401
 from . import chaos  # noqa: F401
+from . import compression  # noqa: F401
 from .ring_attention import (  # noqa: F401
     blockwise_attention, ring_attention, ring_attention_sharded)
 from .pipeline import (pipeline_apply, pipeline_train_step,  # noqa: F401
@@ -52,6 +53,7 @@ __all__ = [
     "padded_size", "pmean", "ppermute", "psum", "reduce_scatter",
     "reduce_scatter_padded", "unflatten",
     "DataParallelStep", "ElasticContext", "kv_retry", "chaos",
+    "compression",
     "ring_attention", "ring_attention_sharded",
     "blockwise_attention", "shard_batch", "replicate", "initialize",
     "pipeline_apply",

@@ -21,6 +21,11 @@ moment the real failure would land:
 * ``checkpoint_write_crash`` — ``checkpoint.atomic_path`` raises
   between the tmp write and the ``os.replace`` commit: the crash window
   atomicity exists to survive.
+* ``grad_compress_corrupt``  — the compressed ZeRO gradient wire's
+  dequantize consumes a garbled chunk-0 max-abs scale (a torn scale
+  side tensor): ``DataParallelStep`` consults per dispatch and threads
+  a non-finite factor into ``compression.dequantize_chunked``;
+  NumericsSanitizer must catch the blast as non-finite params/drift.
 * ``incident_write_crash``   — ``flight_recorder.dump_incident`` raises
   between building the bundle and its ``os.replace`` publish: same
   crash window, same discipline — a reader must never see a partial
@@ -83,6 +88,8 @@ MODES = {
     "kv_garble": "wrap_kv_client read proxy",
     "kv_stall": "wrap_kv_client read proxy",
     "checkpoint_write_crash": "checkpoint.atomic_path commit window",
+    "grad_compress_corrupt": "compressed ZeRO wire dequantize scale "
+                             "(data_parallel dispatch)",
     "incident_write_crash": "flight_recorder.dump_incident publish",
     "artifact_write_crash": "fsutil.atomic_write_path commit window",
     "request_burst": "serve.server.InferenceServer.submit",

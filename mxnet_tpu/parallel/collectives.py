@@ -110,17 +110,31 @@ def unflatten(flat, shape):
     return val[:n].reshape(shape)
 
 
-def reduce_scatter_padded(x, axis_name: str = "dp", axis_size: int = None):
+def reduce_scatter_padded(x, axis_name: str = "dp", axis_size: int = None,
+                          dtype=None):
     """Flat reduce-scatter with uneven-leaf padding (use under
     shard_map).  Flattens ``x``, zero-pads to a multiple of
     ``axis_size`` and psum-scatters — each replica gets the fully
     reduced 1/N slice of the flat leaf.  ``axis_size`` must be the
     static size of ``axis_name`` (shard_map callers know their mesh;
-    the pad amount must be a trace-time constant)."""
+    the pad amount must be a trace-time constant).
+
+    ``dtype`` is the narrow-wire variant (compressed gradient
+    collectives, docs/PERF.md): the operand is explicitly cast to the
+    wire dtype BEFORE the scatter, so the collective moves 1-2 bytes
+    per element instead of 4.  The reduction then accumulates in the
+    wire dtype — callers must guarantee headroom (chunk-scaled
+    quantized values, or a float wire like bf16/fp8 where saturation
+    is the documented rounding), and the matching gather side must
+    spell its widening cast explicitly on the operand
+    (``all_gather_unpad(shard.astype(orig_dtype), ...)``) — the
+    num-collective-dtype lint contract."""
     if axis_size is None:
         raise ValueError("reduce_scatter_padded needs the static "
                          "axis_size (the pad width is shape math)")
     flat = flatten_pad(x, axis_size)
+    if dtype is not None:
+        flat = flat.astype(dtype)
     return lax.psum_scatter(flat, axis_name, scatter_dimension=0,
                             tiled=True)
 
@@ -134,7 +148,8 @@ def all_gather_unpad(shard, shape, axis_name: str = "dp"):
 
 
 def zero_sharded_update(step_fn, w, g, state_leaves, t, lr, *, shape,
-                        mp, axis_size, shard, repl):
+                        mp, axis_size, shard, repl, compress=None,
+                        corrupt=None):
     """One weight's ZeRO-sharded optimizer update (arxiv 2004.13336),
     shared by ``DataParallelStep`` and the Trainer's ``_FusedUpdate``
     so the numerics live in exactly one place.
@@ -148,25 +163,55 @@ def zero_sharded_update(step_fn, w, g, state_leaves, t, lr, *, shape,
     all-gather in the WORKING dtype — under ``mp`` the fp32 master
     (state leaf 0, sharded) is updated and the half-width weight
     re-quantized from it before the gather.  State leaves arrive and
-    leave dp-sharded.  Returns ``(new_weight, new_state_leaves)``."""
+    leave dp-sharded.  Returns ``(new_weight, new_state_leaves)``.
+
+    ``compress`` (``"int8"``/``"fp8"``) narrows the gradient wire
+    (compression.py, docs/PERF.md): the LAST state leaf is the
+    error-feedback residual — the step consumes exactly
+    ``dequantize(quantize(grad + residual))`` and the new residual
+    (the exact quantization error) leaves dp-sharded with the rest of
+    the state, so it re-shards and checkpoints like any ZeRO leaf.
+    ``corrupt`` is the ``grad_compress_corrupt`` chaos operand
+    (traced scalar) threaded into the dequantize."""
     import jax
     from ..optimizer.optimizer import pin_update_dtypes
     wsc = jax.lax.with_sharding_constraint
+    residual = None
+    if compress:
+        residual, state_leaves = state_leaves[-1], state_leaves[:-1]
+
+    def narrow_wire(g_flat):
+        # error-feedback compressed leg: what crosses the (emulated)
+        # narrow wire is dequantize(quantize(comp)); the exact error
+        # becomes the next step's residual leaf
+        from .compression import compress_decompose
+        comp = g_flat + residual.astype(g_flat.dtype)
+        v, new_res = compress_decompose(comp, compress, corrupt=corrupt)
+        return wsc(v, shard), wsc(new_res.astype(residual.dtype), shard)
+
     if mp:
         g32 = wsc(flatten_pad(g.astype(jnp.float32), axis_size), shard)
+        new_res = []
+        if compress:
+            g32, res_leaf = narrow_wire(g32)
+            new_res = [res_leaf]
         master, rest = state_leaves[0], state_leaves[1:]
         res = step_fn(master, g32, t, lr, *rest)
         new_master, new_rest = pin_update_dtypes(res, master, rest)
         new_master = wsc(new_master, shard)
         half = wsc(new_master.astype(w.dtype), repl)
         return (unflatten(half, shape),
-                [new_master] + [wsc(s, shard) for s in new_rest])
+                [new_master] + [wsc(s, shard) for s in new_rest] + new_res)
     gg = wsc(flatten_pad(g, axis_size), shard)
+    new_res = []
+    if compress:
+        gg, res_leaf = narrow_wire(gg)
+        new_res = [res_leaf]
     wflat = wsc(flatten_pad(w, axis_size), shard)
     res = step_fn(wflat, gg, t, lr.astype(w.dtype), *state_leaves)
     new_wflat, new_st = pin_update_dtypes(res, wflat, state_leaves)
     return (unflatten(wsc(new_wflat, repl), shape),
-            [wsc(s, shard) for s in new_st])
+            [wsc(s, shard) for s in new_st] + new_res)
 
 
 def ppermute(x, perm, axis_name: str = "dp"):

@@ -103,10 +103,22 @@ class DataParallelStep:
     state and update compute shard over the ``dp`` axis — reduce-scatter
     grads, update the local 1/N shard, all-gather params — cutting
     per-chip optimizer-state memory ~N-fold.  See docs/PERF.md.
+
+    ``grad_compression="int8"|"fp8"|None|"auto"`` narrows the sharded
+    path's gradient wire (parallel/compression.py): the flat padded
+    gradient is chunk-quantized to a 1-byte payload before the
+    reduce-scatter and dequantized-with-error-feedback on the local
+    shard — the residual rides as an extra dp-sharded state leaf, so
+    it re-shards and checkpoints with the rest of the ZeRO state.
+    ``"auto"`` consults the ``prog_compress`` cost-table family
+    (lookup only); with no measured entry the heuristic keeps the
+    wire uncompressed.  Requires the sharded update — on a 1-device
+    or unsharded layout compression quietly disables.
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, donate=True,
-                 mirror=None, donate_batch=False, shard_optimizer=False):
+                 mirror=None, donate_batch=False, shard_optimizer=False,
+                 grad_compression=None):
         self._net = net
         self._loss = loss_fn
         self._opt = optimizer
@@ -157,6 +169,17 @@ class DataParallelStep:
         # the raw knob is kept for elastic re-formation: reshard() must
         # re-resolve "auto" against the NEW mesh's dp extent
         self._shard_knob = shard_optimizer
+        # compressed gradient wire (parallel/compression.py): resolved
+        # to "" (off) or a compression.MODES member; "auto" is a
+        # prog_compress cost-table lookup keyed (params, dp, dtype).
+        # Only meaningful on the sharded update — the knob re-resolves
+        # on reshard() together with shard_optimizer.
+        self._compress_knob = grad_compression
+        self._compress = self._resolve_grad_compression(grad_compression)
+        # chaos: device-resident grad_compress_corrupt operands (1.0 =
+        # clean, inf = garbled chunk-0 scale), lazily built per process
+        self._corrupt_ok_dev = None
+        self._corrupt_fire_dev = None
         # NOTE: the flattened leaf lists below are NOT covered by the
         # optimizer's own state treedef — multi-precision slots carry the
         # fp32 master as an EXTRA leaf 0 prepended after flattening, and
@@ -167,6 +190,7 @@ class DataParallelStep:
         self._mp_slots = []
         self._shard_slots = []   # per-slot: flat-sharded layout in use?
         self._shard_meta = []    # per-slot: natural (master) shape
+        self._base_leaves = []   # per-slot: leaf count sans residual
         self._mp_written = {}   # slot -> last weight array THIS step wrote
         mp = bool(getattr(optimizer, "multi_precision", False))
         for slot, i in enumerate(self._trainable):
@@ -180,6 +204,8 @@ class DataParallelStep:
                 leaves = self._create_sharded_state(optimizer, slot, wdata)
                 if leaves is not None:
                     self._shard_slots.append(True)
+                    self._base_leaves.append(
+                        len(leaves) - (1 if self._compress else 0))
                     self._opt_states.append(leaves)
                     continue
             self._shard_slots.append(False)
@@ -188,6 +214,7 @@ class DataParallelStep:
                 st, is_leaf=lambda x: isinstance(x, NDArray))
             if use_mp:
                 leaves = [wdata] + leaves     # master rides as leaf 0
+            self._base_leaves.append(len(leaves))
             # commit state buffers to the weight's device so the first call
             # and post-donation calls see identical arg shardings (one
             # compile, not two)
@@ -274,6 +301,90 @@ class DataParallelStep:
                         tuner_source=src)
         return shard
 
+    def _trainable_param_stats(self):
+        """(param count, dominant dtype string) of the trainable set —
+        the workload key the compression decision is made on."""
+        pcount, dtype = 0, "float32"
+        try:
+            for _, p in sorted(self._net.collect_params().items()):
+                if p._data is None or p.grad_req == "null":
+                    continue
+                if pcount == 0:
+                    dtype = str(onp.dtype(p._data.dtype))
+                pcount += int(onp.prod(p._data.shape))
+        except Exception:
+            pcount = 0
+        return pcount, dtype
+
+    def _resolve_grad_compression(self, knob):
+        """Resolve the ``grad_compression`` knob to "" (uncompressed)
+        or a wire mode; every resolution journals one
+        ``compress/decision`` event (the census's per-decision record:
+        mode, ratio, which path decided)."""
+        from .compression import MODES
+        if knob in (None, False, "", 0, "0", "off"):
+            return ""
+        if knob not in MODES + ("auto",):
+            raise ValueError(
+                "grad_compression must be one of %s, None or 'auto', "
+                "got %r" % (MODES, knob))
+        if self._shard_n < 2:
+            # compression IS the narrow ZeRO wire: with the sharded
+            # update off (no dp axis, shard_optimizer off) or the
+            # 1-device degenerate (no wire at all) there is no gradient
+            # reduce-scatter to narrow — quietly disable, journal why
+            self._journal_compress_decision("", knob, "disabled",
+                                            "layout")
+            return ""
+        if knob == "auto":
+            mode, path, src = self._auto_compress_decision(self._shard_n)
+        else:
+            mode, path, src = knob, "forced", "arg"
+        self._journal_compress_decision(mode, knob, path, src)
+        return mode
+
+    def _auto_compress_decision(self, n):
+        """``"auto"``: MEASURED when the cost table holds a
+        ``prog_compress`` entry for this (canonical param count, dp
+        extent, dtype) — compression changes numerics, so the
+        heuristic default is OFF until a measured entry (the bench's
+        A/B or the offline search) says the wire win is real."""
+        pcount, dtype = self._trainable_param_stats()
+        mode, path, src = "", "heuristic", "heuristic"
+        if pcount > 0:
+            try:
+                from ..tune import program as _prog
+                cfg = _prog.program_config(
+                    "prog_compress",
+                    (_prog.canon_param_count(pcount), n), dtype=dtype)
+            except Exception:
+                cfg = None
+            if cfg is not None:
+                from ..tune.program import MODE_CODES
+                mode = MODE_CODES[int(cfg["mode"])]
+                path, src = "measured", cfg.get("source", "table")
+        return mode, path, src
+
+    def _journal_compress_decision(self, mode, requested, path, src):
+        """One ``compress/decision`` journal record + the byte gauges:
+        what the wire will carry per step vs the f32 baseline (schedule
+        arithmetic, the same discipline as reduce_scatter_bytes)."""
+        from . import compression as _comp
+        pcount, dtype = self._trainable_param_stats()
+        base = _comp.wire_bytes(pcount, None)
+        wire = _comp.wire_bytes(pcount, mode or None)
+        scale = _comp.scale_bytes(pcount, mode or None)
+        telemetry.gauge("compression.bytes_saved",
+                        max(0, base - wire - scale))
+        telemetry.gauge("compression.scale_bytes", scale)
+        telemetry.event(
+            "compress", "decision", mode=mode or "off",
+            requested=str(requested), path=path, tuner_source=src,
+            dp=int(self._shard_n or 0), params=int(pcount), dtype=dtype,
+            wire_bytes=int(wire), scale_bytes=int(scale),
+            f32_bytes=int(base),
+            ratio=round(base / float(wire), 3) if wire else 1.0)
+
     def _shard_sharding(self, replicated=False):
         import jax.sharding as jsh
         spec = jsh.PartitionSpec() if replicated else jsh.PartitionSpec("dp")
@@ -309,6 +420,14 @@ class DataParallelStep:
             vals.append(jax.device_put(v, self._shard_sharding()))
         if self._mp_slots[slot]:
             vals = [wflat] + vals    # master rides as leaf 0, sharded too
+        if self._compress:
+            # error-feedback residual: LAST leaf, zero-initialized, in
+            # the grad-wire dtype (f32 under mp).  Living inside the
+            # dp-sharded state means elastic.reshard and the checkpoint
+            # path carry it bitwise for free.
+            rdt = jnp.float32 if self._mp_slots[slot] else wflat.dtype
+            vals.append(jax.device_put(jnp.zeros(wflat.shape, rdt),
+                                       self._shard_sharding()))
         return vals
 
     def optimizer_state_bytes(self, per_chip=True):
@@ -338,7 +457,8 @@ class DataParallelStep:
         telemetry.gauge("parallel.optimizer_state_bytes_total", total)
         if not self._shard_n:
             return
-        rs_bytes = ag_bytes = 0
+        from . import compression as _comp
+        rs_bytes = ag_bytes = wire_bytes = scale_bytes = 0
         for slot, i in enumerate(self._trainable):
             if not self._shard_slots[slot]:
                 continue
@@ -349,13 +469,23 @@ class DataParallelStep:
             itemsize = onp.dtype(w.dtype).itemsize
             rs_bytes += (4 if self._mp_slots[slot] else itemsize) * nelem
             ag_bytes += itemsize * nelem
+            if self._compress:
+                wire_bytes += _comp.wire_bytes(nelem, self._compress)
+                scale_bytes += _comp.scale_bytes(nelem, self._compress)
         telemetry.event(
             "zero", "shard_optimizer", axis="dp", n_shards=self._shard_n,
             sharded_slots=sum(self._shard_slots),
             replicated_slots=len(self._shard_slots)
             - sum(self._shard_slots),
             state_bytes_per_chip=per_chip, state_bytes_total=total,
-            reduce_scatter_bytes=rs_bytes, all_gather_bytes=ag_bytes)
+            reduce_scatter_bytes=rs_bytes, all_gather_bytes=ag_bytes,
+            grad_compression=self._compress or "off",
+            compressed_wire_bytes=wire_bytes,
+            compression_scale_bytes=scale_bytes)
+        if self._compress:
+            telemetry.gauge("compression.bytes_saved",
+                            max(0, rs_bytes - wire_bytes - scale_bytes))
+            telemetry.gauge("compression.scale_bytes", scale_bytes)
 
     def hbm_estimate(self, activations=()):
         """Static per-chip HBM estimate of this step's resident leaves
@@ -448,8 +578,27 @@ class DataParallelStep:
         layout: flat zero-padded dp-sharded when the step shards and
         every leaf is weight-shaped (the ``create_state_flat``
         elementwise contract), replicated otherwise.  Updates the
-        per-slot layout flag."""
+        per-slot layout flag.
+
+        Error-feedback residuals reconcile HERE, the single seam both
+        elastic reshard and checkpoint restore pass through: a leaf
+        set carrying a residual this layout doesn't use drops it, and
+        a compressed layout restoring residual-less leaves (e.g. an
+        uncompressed checkpoint) starts one at zero — error feedback
+        restarts cleanly, nothing else is touched."""
         shape = tuple(self._shard_meta[slot])
+        nat_leaves = list(nat_leaves)
+        will_shard = bool(self._shard_n) and all(
+            tuple(onp.shape(l)) == shape for l in nat_leaves)
+        want = self._base_leaves[slot] + (
+            1 if (self._compress and will_shard) else 0)
+        if len(nat_leaves) == want + 1:
+            nat_leaves = nat_leaves[:-1]
+        elif len(nat_leaves) == want - 1:
+            rdt = onp.float32 if self._mp_slots[slot] else \
+                onp.dtype(self._params[self._trainable[slot]]
+                          .data().dtype)
+            nat_leaves.append(onp.zeros(shape, rdt))
         if self._shard_n and all(tuple(onp.shape(l)) == shape
                                  for l in nat_leaves):
             self._shard_slots[slot] = True
@@ -479,6 +628,10 @@ class DataParallelStep:
                     for slot in range(len(self._opt_states))]
         self._mesh = mesh
         self._shard_n = self._resolve_shard_optimizer(self._shard_knob)
+        # the compression knob re-resolves against the NEW layout ("auto"
+        # may flip with the dp extent; losing the sharded update disables
+        # the wire) — _place_slot reconciles residual leaves either way
+        self._compress = self._resolve_grad_compression(self._compress_knob)
         moved = 0
         repl = self._shard_sharding(replicated=True) \
             if mesh is not None else None
@@ -787,25 +940,33 @@ class DataParallelStep:
                     # sharded masters live flat-padded over dp
                     master = self._shard_put(master)
                 self._opt_states[slot][0] = master
-        new_pvals, new_states, self._t_dev, self._rng_dev, loss = jfn(
-            pvals, self._opt_states, self._t_dev, self._lrs_dev,
-            self._rng_dev, dval, lval)
+        argv = [pvals, self._opt_states, self._t_dev, self._lrs_dev,
+                self._rng_dev, dval, lval]
+        if self._compress:
+            # grad_compress_corrupt chaos seam: consulted host-side per
+            # dispatch; the fired/clean outcome rides into the program
+            # as a traced scalar multiplied into chunk 0's wire scale
+            # inside the dequantize (compression.dequantize_chunked) —
+            # same compiled program either way, no retrace
+            from . import chaos
+            if self._corrupt_ok_dev is None:
+                self._corrupt_ok_dev = jnp.asarray(1.0, jnp.float32)
+                self._corrupt_fire_dev = jnp.asarray(onp.inf, jnp.float32)
+            argv.append(self._corrupt_fire_dev if chaos.should_fire(
+                "grad_compress_corrupt", step=self._t)
+                else self._corrupt_ok_dev)
+        new_pvals, new_states, self._t_dev, self._rng_dev, loss = jfn(*argv)
         if self._donate_batch:
             # remember this call's donated buffers so re-feeding one
             # raises in prep — accumulated (not replaced) so a buffer
-            # donated several steps ago is still caught
-            # graftlint: disable-next=donate-use-after-donate -- the
-            # ring stores the donated SHELLS for the re-feed identity
-            # guard in prep(); no buffer contents are read
+            # donated several steps ago is still caught; these store
+            # the donated SHELLS for the re-feed identity guard in
+            # prep(), no buffer contents are read
             donated = [d for d in (dval if isinstance(dval, tuple)
                                    else (dval,)) if d is not None]
             self._donated_batch.extend(donated)
             if lval is not None:
-                # graftlint: disable-next=donate-use-after-donate --
-                # shell identity bookkeeping only, no buffer read
                 self._donated_batch.append(lval)
-                # graftlint: disable-next=donate-use-after-donate --
-                # shell identity bookkeeping only, no buffer read
                 donated.append(lval)
             telemetry.inc("donation.batch_buffers", len(donated))
         for p, v in zip(self._params, new_pvals):
@@ -826,6 +987,7 @@ class DataParallelStep:
         shard_slots = self._shard_slots
         shard_meta = self._shard_meta
         shard_n = self._shard_n
+        compress = self._compress
         if shard_n:
             from .collectives import zero_sharded_update
             SHARD = self._shard_sharding()
@@ -833,19 +995,24 @@ class DataParallelStep:
         trainset = set(trainable)
         steps = [optimizer.make_step(slot) for slot, _ in enumerate(trainable)]
 
-        def sharded_update(slot, i, w, g, t, lrs, st_leaves):
+        def sharded_update(slot, i, w, g, t, lrs, st_leaves,
+                           corrupt=None):
             """ZeRO-style update of one slot (arxiv 2004.13336): the
             gradient's producer is the global-batch mean, so its shard
             constraint lowers to a reduce-scatter; the optimizer math
             runs on the local 1/N shard and the updated weight all-
             gathers back in the working dtype.  State leaves stay
             sharded across steps — 1/N of the replicated footprint per
-            chip.  The numerics live in collectives.zero_sharded_update
-            (shared with the Trainer's fused path)."""
+            chip.  With ``compress`` the wire leg is chunk-quantized
+            and the slot's LAST leaf carries the error-feedback
+            residual.  The numerics live in
+            collectives.zero_sharded_update (shared with the Trainer's
+            fused path)."""
             return zero_sharded_update(
                 steps[slot], w, g, st_leaves, t, lrs[slot],
                 shape=shard_meta[slot], mp=mp_slots[slot],
-                axis_size=shard_n, shard=SHARD, repl=REPL)
+                axis_size=shard_n, shard=SHARD, repl=REPL,
+                compress=compress or None, corrupt=corrupt)
 
         def run_forward(pvals, rng, dval, lval):
             """Swap traced values into the blocks' parameters, run the
@@ -884,7 +1051,8 @@ class DataParallelStep:
 
         fwd = _mirror_wrap(run_forward, self._mirror)
 
-        def step_fn(pvals, opt_states, t, lrs, rng, dval, lval):
+        def step_fn(pvals, opt_states, t, lrs, rng, dval, lval,
+                    corrupt=None):
             # the step counter and RNG key are device-resident carries:
             # advanced inside the program, returned for the next call (no
             # per-step host->device transfer)
@@ -906,7 +1074,8 @@ class DataParallelStep:
                 st_leaves = opt_states[slot]
                 if shard_slots[slot]:
                     new_pvals[i], new_st = sharded_update(
-                        slot, i, pvals[i], g, t, lrs, st_leaves)
+                        slot, i, pvals[i], g, t, lrs, st_leaves,
+                        corrupt)
                     new_states.append(new_st)
                     continue
                 if mp_slots[slot]:
@@ -943,11 +1112,13 @@ class DataParallelStep:
 
         from jax import lax
 
-        def scan_fn(pvals, opt_states, t, lrs, rng, dseq, lseq):
+        def scan_fn(pvals, opt_states, t, lrs, rng, dseq, lseq,
+                    corrupt=None):
             def body(carry, xs):
                 pv, st, tt, key = carry
                 d, l = xs
-                npv, nst, tt, key, loss = step_fn(pv, st, tt, lrs, key, d, l)
+                npv, nst, tt, key, loss = step_fn(pv, st, tt, lrs, key,
+                                                  d, l, corrupt)
                 return (npv, nst, tt, key), loss
             (pvals, opt_states, t, rng), losses = lax.scan(
                 body, (pvals, opt_states, t, rng), (dseq, lseq))

@@ -42,6 +42,10 @@ FAMILY_FIELDS = {
     "prog_scan": ("k",),
     "prog_zero": ("shard",),
     "prog_buckets": ("max_bucket", "levels"),
+    # gradient-wire compression mode (0 off / 1 int8 / 2 fp8) — the
+    # ONE program family keyed on the real operand dtype (see
+    # _KEY_DTYPE): the wire narrowing is a dtype decision
+    "prog_compress": ("mode",),
 }
 
 # kernel families a table MISS may trigger a measured kernel search for
@@ -57,7 +61,9 @@ KERNEL_FAMILIES = ("attention", "fused_norm", "layernorm")
 _KEY_DTYPE = {"fused_norm": "float32", "layernorm": "float32",
               # program knobs are dtype-blind by construction: their
               # shapes are workload descriptors (batch, params, dp...),
-              # not array operands
+              # not array operands — EXCEPT prog_compress, whose knob
+              # is precisely a wire-dtype choice and therefore keys on
+              # the real gradient dtype
               "prog_prefetch": "float32", "prog_scan": "float32",
               "prog_zero": "float32", "prog_buckets": "float32"}
 

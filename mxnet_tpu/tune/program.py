@@ -16,7 +16,13 @@ observes:
 * ``prog_buckets`` — the serving bucket menu ``(max_bucket, levels)``
   (a geometric menu, :func:`menu_from_config`), keyed on max batch and
   pre-validated against the static HBM estimator (``tools.lint.hbm``)
-  before a single executable is compiled.
+  before a single executable is compiled;
+* ``prog_compress`` — the ZeRO gradient-wire compression mode (0 off /
+  1 int8 / 2 fp8, :data:`MODE_CODES`), keyed on (canonical param
+  count, dp extent) AND the real operand dtype — the one family that
+  is NOT dtype-blind, since the wire narrowing is a dtype decision:
+  the measurement that turns ``grad_compression="auto"`` from a
+  do-nothing heuristic into a decision.
 
 Everything rides the SAME cost-table store as the kernel families —
 same JSONL schema, same atomic rewrite + sidecar flock, same
@@ -43,20 +49,26 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from . import cost_table
 from .cost_table import FAMILY_FIELDS, canon_shape
 
-__all__ = ["PROGRAM_FAMILIES", "heuristic_config", "valid_config",
-           "candidates", "successive_halving", "coordinate_descent",
-           "search_program", "program_config", "program_knobs",
-           "menu_from_config", "config_from_menu", "validate_menu",
-           "canon_param_count", "default_measure", "run_program_search"]
+__all__ = ["PROGRAM_FAMILIES", "MODE_CODES", "heuristic_config",
+           "valid_config", "candidates", "successive_halving",
+           "coordinate_descent", "search_program", "program_config",
+           "program_knobs", "menu_from_config", "config_from_menu",
+           "validate_menu", "canon_param_count", "default_measure",
+           "run_program_search"]
 
 PROGRAM_FAMILIES = ("prog_prefetch", "prog_scan", "prog_zero",
-                    "prog_buckets")
+                    "prog_buckets", "prog_compress")
+
+# prog_compress mode codes: table entries store the int, consumers map
+# it to the DataParallelStep/Trainer grad_compression knob value
+MODE_CODES = ("", "int8", "fp8")
 
 # knob axes (grid per field, deterministic order)
 _AXES = {
     "prog_prefetch": {"depth": (1, 2, 4, 8), "workers": (1, 2, 4)},
     "prog_scan": {"k": (1, 2, 4, 8)},
     "prog_zero": {"shard": (0, 1)},
+    "prog_compress": {"mode": (0, 1, 2)},
 }
 
 
@@ -83,6 +95,12 @@ def heuristic_config(family: str,
         # current "auto" heuristic: shard whenever the mesh gives >1 way
         _, dp = shape
         return {"shard": 1 if int(dp) > 1 else 0}
+    if family == "prog_compress":
+        # compression changes numerics (error feedback provably
+        # recovers it, but the wire win is workload-dependent): the
+        # heuristic keeps the wire uncompressed — "auto" engages only
+        # through a MEASURED table entry
+        return {"mode": 0}
     if family == "prog_buckets":
         (max_batch,) = shape
         mb = 1 << max(0, (int(max_batch) - 1).bit_length())
@@ -108,6 +126,11 @@ def valid_config(family: str, shape: Sequence[int],
             s = int(config["shard"])
             # sharding needs >1 way to shard over
             return s in (0, 1) and (s == 0 or int(dp) > 1)
+        if family == "prog_compress":
+            _, dp = shape
+            m = int(config["mode"])
+            # a compressed wire needs a sharded update to narrow
+            return m in (0, 1, 2) and (m == 0 or int(dp) > 1)
         if family == "prog_buckets":
             mb, lv = int(config["max_bucket"]), int(config["levels"])
             return mb >= 1 and mb & (mb - 1) == 0 \
@@ -335,20 +358,24 @@ def search_program(family: str, shape: Sequence[int], measure=None,
 # ---------------------------------------------------------------------------
 
 def program_config(family: str, shape: Sequence[int],
-                   quiet: bool = False) -> Optional[dict]:
+                   quiet: bool = False,
+                   dtype: str = "float32") -> Optional[dict]:
     """The measured schedule decision for one instance, or None (→
     caller keeps its heuristic).  Pure lookup + validation: program
     measures build meshes and spin threads, so a miss NEVER searches
     inline — ``python -m mxnet_tpu.tune --program`` (or a bench) fills
     the table offline.  Emits ``autotune.program_hit|miss|fallback``
     counters and one ``autotune_program`` journal event per decision;
-    ``quiet=True`` is the side-effect-free secondary-lookup spelling."""
+    ``quiet=True`` is the side-effect-free secondary-lookup spelling.
+    ``dtype`` only distinguishes entries for families canon_dtype
+    leaves dtype-aware (``prog_compress``); the dtype-blind families
+    pin their key dtype regardless."""
     if family not in PROGRAM_FAMILIES:
         raise ValueError("unknown program family %r" % (family,))
     from . import get_table
     from .. import telemetry
     shape = canon_shape(shape)
-    rec = get_table().lookup(family, shape, "float32")
+    rec = get_table().lookup(family, shape, dtype)
     if rec is not None and valid_config(family, shape, rec["config"]):
         if not quiet:
             telemetry.inc("autotune.program_hit")
@@ -372,31 +399,33 @@ def program_config(family: str, shape: Sequence[int],
 
 
 def program_knobs(family: str, shape: Sequence[int], default=None,
-                  quiet: bool = False):
+                  quiet: bool = False, dtype: str = "float32"):
     """Tuned knobs as a tuple in the family's field order
     (``prog_prefetch`` -> ``(depth, workers)``; single-field families
     return the scalar), or ``default`` on a miss — the direct-consumer
     spelling, mirroring ``table_blocks``: graftlint resolves the
     ``default=`` literal where one feeds kernel sizing."""
-    cfg = program_config(family, shape, quiet=quiet)
+    cfg = program_config(family, shape, quiet=quiet, dtype=dtype)
     if cfg is None:
         return default
     out = tuple(cfg[f] for f in FAMILY_FIELDS[family])
     return out if len(out) > 1 else out[0]
 
 
-def record_program(family: str, shape: Sequence[int], res: dict):
+def record_program(family: str, shape: Sequence[int], res: dict,
+                   dtype: str = "float32"):
     """Persist one search result under the shared store's discipline."""
     from . import get_table
     return get_table().record(
-        family, canon_shape(shape), "float32", res["config"],
+        family, canon_shape(shape), dtype, res["config"],
         best_ms=res.get("best_ms"), source=res.get("source", "searched"),
         trials=res.get("trials"), interpret=res.get("interpret", False),
         results=res.get("results"))
 
 
 def run_program_search(family: str, shape: Optional[Sequence[int]] = None,
-                       calls: int = 2, record: bool = True, **kw):
+                       calls: int = 2, record: bool = True,
+                       dtype: str = "float32", **kw):
     """Search one family end-to-end (CLI / bench entry): derive the
     default instance shape when none is given, run the measured search,
     journal it, and persist the winner."""
@@ -414,7 +443,7 @@ def run_program_search(family: str, shape: Optional[Sequence[int]] = None,
                     strategy=res.get("strategy"),
                     tuner_source="searched")
     if record:
-        record_program(family, shape, res)
+        record_program(family, shape, res, dtype=dtype)
     return res
 
 
@@ -435,7 +464,7 @@ def default_shape(family: str) -> Tuple[int, ...]:
         return (_PREFETCH_BATCH,)
     if family == "prog_scan":
         return _SCAN_SHAPE
-    if family == "prog_zero":
+    if family in ("prog_zero", "prog_compress"):
         import jax
         batch, hidden = _ZERO_SHAPE
         return (canon_param_count(_zero_param_count(hidden)),
@@ -457,6 +486,9 @@ def default_measure(family: str, shape: Sequence[int]):
     if family == "prog_zero":
         return lambda cfg, calls: measure_zero(cfg["shard"],
                                                calls=calls)
+    if family == "prog_compress":
+        return lambda cfg, calls: measure_compress(cfg["mode"],
+                                                   calls=calls)
     if family == "prog_buckets":
         return lambda cfg, calls: measure_buckets(menu_from_config(cfg),
                                                   max_batch=shape[0],
@@ -550,7 +582,7 @@ def _zero_param_count(hidden=_ZERO_SHAPE[1]) -> int:
     return (123 * hidden + hidden) + (hidden * h2 + h2) + (h2 * 10 + 10)
 
 
-def _zero_step(shard, batch, hidden):
+def _zero_step(shard, batch, hidden, grad_compression=None):
     """One compiled DataParallelStep of the probe MLP (the same net
     bench.py's zero_sharded_update leg times) + its batch."""
     import numpy as onp
@@ -574,7 +606,8 @@ def _zero_step(shard, batch, hidden):
     step = parallel.DataParallelStep(
         net, lambda o, l: loss_fn(o, l),
         mx.optimizer.Adam(learning_rate=1e-3), mesh=mesh,
-        shard_optimizer=bool(shard) and n > 1)
+        shard_optimizer=bool(shard) and n > 1,
+        grad_compression=grad_compression or None)
     step(x, y)          # compile + first update
     return step, (x, y)
 
@@ -585,6 +618,23 @@ def measure_zero(shard, batch=_ZERO_SHAPE[0], hidden=_ZERO_SHAPE[1],
     replicated (``shard=0``) or ZeRO-sharded (``shard=1``)."""
     import time as _time
     step, (x, y) = _zero_step(shard, batch, hidden)
+    best = None
+    for _ in range(max(1, int(calls)) * iters):
+        t0 = _time.perf_counter()
+        step(x, y).asnumpy()
+        dt = (_time.perf_counter() - t0) * 1e3
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def measure_compress(mode, batch=_ZERO_SHAPE[0], hidden=_ZERO_SHAPE[1],
+                     calls=2, iters=4):
+    """ms per SHARDED train step of the probe MLP with the gradient
+    wire uncompressed (``mode=0``) or chunk-quantized (1 = int8,
+    2 = fp8) — the measurement behind ``grad_compression="auto"``."""
+    import time as _time
+    step, (x, y) = _zero_step(1, batch, hidden,
+                              grad_compression=MODE_CODES[int(mode)])
     best = None
     for _ in range(max(1, int(calls)) * iters):
         t0 = _time.perf_counter()

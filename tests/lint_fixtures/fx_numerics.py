@@ -210,6 +210,27 @@ def collective_pair_same_dtype_is_clean(g):
     return all_gather_unpad(shard, (100,), "dp")
 
 
+@jax.jit
+def compressed_wire_explicit_is_clean(g):
+    # the compressed ZeRO wire (parallel/compression.py): the int8
+    # payload reduce-scatters narrow and the gather side spells the
+    # widening cast ON the operand — the working-dtype handoff is
+    # visible at the pair, exactly like the bf16 all-gather above
+    q = (g.astype(jnp.float32) * 12.7).astype(jnp.int8)
+    shard = reduce_scatter_padded(q, "dp", axis_size=8)
+    return all_gather_unpad(shard.astype(jnp.float32), (100,), "dp")
+
+
+@jax.jit
+def compressed_wire_missing_cast_bad(g):
+    # same wire, but the widening hides behind a name binding: the
+    # pair reads as int8-down / float32-up with no visible conversion
+    q = (g.astype(jnp.float32) * 12.7).astype(jnp.int8)
+    shard = reduce_scatter_padded(q, "dp", axis_size=8)
+    wide = shard.astype(jnp.float32)
+    return all_gather_unpad(wide, (100,), "dp")  # expect: num-collective-dtype
+
+
 # -- float64 / weak-literal surprises ----------------------------------------
 
 @jax.jit

@@ -83,6 +83,15 @@ def pairing_ok():
     return all_gather_unpad(s, (100,), "dp")
 
 
+def pairing_compressed_wire_ok():
+    # the narrow-wire spelling (compressed ZeRO grads): dtype= on the
+    # reduce-scatter plus an explicit widening cast on the gather
+    # operand — same padded sizes, same axis, must stay silent
+    g = jnp.zeros((100,), jnp.int8)
+    s = reduce_scatter_padded(g, "dp", axis_size=8, dtype=jnp.int8)
+    return all_gather_unpad(s.astype(jnp.float32), (100,), "dp")
+
+
 # -- collective issue order (the multi-host deadlock shapes) -----------------
 
 def order_divergent(mesh, x):

@@ -257,3 +257,68 @@ def test_serving_latency_percentiles_come_from_histograms(bench):
     fat = dict(art, latency_ok=False,
                legs=[dict(leg, p99_ms=leg["p50_ms"] * 20)])
     assert any("p99" in hh for hh in bench._hard_failures([fat]))
+
+
+def _gc_detail(**over):
+    """A green grad_compression bench detail (the MULTICHIP_r06 leg)."""
+    d = {"bench": "grad_compression", "batch_size": 256, "hidden": 1024,
+         "n_shards": 8, "padded_params": 656912,
+         "legs": [
+             {"mode": "f32", "step_ms": 50.0,
+              "grad_wire_bytes_per_chip": 2627648,
+              "scale_bytes_per_chip": 0},
+             {"mode": "int8", "step_ms": 60.0,
+              "grad_wire_bytes_per_chip": 656912,
+              "scale_bytes_per_chip": 10268, "wire_ratio": 4.0,
+              "parity_max_abs": 8e-4, "parity_tol": 1e-2,
+              "engaged": True, "parity_ok": True, "compressed_ok": True},
+             {"mode": "fp8", "step_ms": 80.0,
+              "grad_wire_bytes_per_chip": 656912,
+              "scale_bytes_per_chip": 10268, "wire_ratio": 4.0,
+              "parity_max_abs": 2e-4, "parity_tol": 5e-3,
+              "engaged": True, "parity_ok": True, "compressed_ok": True}],
+         "reshard": {"world_from": 8, "world_to": 4,
+                     "residual_bitwise_ok": True,
+                     "loss_finite_after": True, "still_compressed": True},
+         "compressed_ok": True, "parity_ok": True}
+    d.update(over)
+    return d
+
+
+def test_hard_failures_gate_grad_compression_wire(bench):
+    """ISSUE 20: compressed_ok:false — the wire never engaged or the
+    payload ratio came in under the 4x contract — is a nonzero bench
+    exit; the green leg passes clean."""
+    assert bench._hard_failures([_gc_detail()]) == []
+    bad = _gc_detail(compressed_ok=False)
+    bad["legs"] = [dict(bad["legs"][0]),
+                   dict(bad["legs"][1], engaged=False, wire_ratio=1.0,
+                        compressed_ok=False),
+                   dict(bad["legs"][2])]
+    hard = bench._hard_failures([bad])
+    assert any("int8" in h and "wire_ratio" in h for h in hard)
+    crash = {"bench": "grad_compression",
+             "error": "RuntimeError('boom')", "compressed_ok": False}
+    assert any("crashed" in h for h in bench._hard_failures([crash]))
+
+
+def test_hard_failures_gate_grad_compression_parity(bench):
+    """A loss-parity breach on a compressed leg is a hard failure: a
+    wire that saves bytes by corrupting gradients must never cut an
+    artifact."""
+    bad = _gc_detail(parity_ok=False)
+    bad["legs"] = [dict(bad["legs"][0]), dict(bad["legs"][1]),
+                   dict(bad["legs"][2], parity_max_abs=0.5,
+                        parity_ok=False)]
+    hard = bench._hard_failures([bad])
+    assert any("fp8" in h and "parity breach" in h for h in hard)
+
+
+def test_hard_failures_gate_grad_compression_reshard(bench):
+    """The elastic reshard leg's residual bitwise check gates hard:
+    error-feedback state that fails to migrate byte-exact (or kills
+    training) fails the run."""
+    bad = _gc_detail(compressed_ok=False)
+    bad["reshard"] = dict(bad["reshard"], residual_bitwise_ok=False)
+    hard = bench._hard_failures([bad])
+    assert any("bitwise" in h for h in hard)

@@ -158,6 +158,7 @@ def parse_jsonl(lines):
     model = {"errors": [], "fallbacks": {}, "picks": 0}
     program = []
     elastic = []
+    compress = []
     serve = {"events": {}, "batches": 0, "fill_pct_sum": 0.0,
              "queue_depth_sum": 0, "wait_ms_sum": 0.0, "states": []}
     lint_gate = None
@@ -268,6 +269,17 @@ def parse_jsonl(lines):
                             "source": rec.get("tuner_source"),
                             "config": {"shard": rec.get("shard")},
                             "detail": rec.get("path")})
+        elif kind == "compress":
+            # compressed-collective decisions (parallel/compression.py
+            # wire, journaled by DataParallelStep / Trainer at each
+            # grad_compression resolution): one row per decision with
+            # the schedule-arithmetic wire bytes vs the f32 baseline
+            if rec.get("name") == "decision":
+                compress.append(
+                    {k: rec.get(k) for k in
+                     ("mode", "requested", "path", "tuner_source", "dp",
+                      "params", "dtype", "wire_bytes", "scale_bytes",
+                      "f32_bytes", "ratio")})
         elif kind in ("elastic", "ckpt"):
             # elastic-transition / checkpoint journal events (one per
             # detect/reshard/write/restore — mxnet_tpu.parallel.elastic
@@ -337,7 +349,8 @@ def parse_jsonl(lines):
             "recompiles": recompiles, "steps": steps, "hbm": hbm,
             "lockorder": lockorder, "numerics": numerics,
             "autotune": autotune, "model": model, "program": program,
-            "elastic": elastic, "serve": serve, "lint_gate": lint_gate,
+            "elastic": elastic, "compress": compress, "serve": serve,
+            "lint_gate": lint_gate,
             "chaos_audit": chaos_audit, "histograms": histograms,
             "traces": traces, "incidents": incidents}
 
@@ -407,6 +420,8 @@ def render_jsonl(agg, fmt="markdown"):
     out.extend(_render_model(agg.get("model") or {},
                              agg.get("counters") or {}, fmt))
     out.extend(_render_program(agg.get("program") or [], fmt))
+    out.extend(_render_compress(agg.get("compress") or [],
+                                agg.get("gauges") or {}, fmt))
     out.extend(_render_elastic(agg.get("elastic") or [], fmt))
     out.extend(_render_serve(agg.get("serve") or {},
                              agg.get("counters") or {}, fmt))
@@ -664,6 +679,44 @@ def _render_elastic(elastic, fmt="markdown"):
         vals = [e["event"], cell(e.get("step")), cell(e.get("world")),
                 cell(e.get("bytes")), cell(e.get("dur_ms")),
                 cell(e.get("detail"))]
+        out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
+                   else "\t".join(vals))
+    return out
+
+
+def _render_compress(compress, gauges, fmt="markdown"):
+    """Gradient-compression census from the compress/decision journal:
+    one row per knob resolution (mode, who decided, dp extent, and the
+    schedule-arithmetic bytes on the wire vs the f32 baseline), headed
+    by the final wire-savings gauges."""
+    if not compress and not any(k.startswith("compression.")
+                                for k in gauges):
+        return []
+    out = ["", "gradient compression census:"]
+    saved = gauges.get("compression.bytes_saved")
+    scale = gauges.get("compression.scale_bytes")
+    if saved is not None or scale is not None:
+        out.append("  wire bytes saved/step: %s (scale side tensor: %s)"
+                   % ("%.6g" % saved if saved is not None else "-",
+                      "%.6g" % scale if scale is not None else "-"))
+    if not compress:
+        return out
+    header = ["mode", "requested", "path", "source", "dp", "params",
+              "dtype", "wire-B", "scale-B", "f32-B", "ratio"]
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("| " + " | ".join("---" for _ in header) + " |")
+
+    def cell(v):
+        return "-" if v is None else str(v)
+
+    for d in compress:
+        vals = [cell(d.get("mode")), cell(d.get("requested")),
+                cell(d.get("path")), cell(d.get("tuner_source")),
+                cell(d.get("dp")), cell(d.get("params")),
+                cell(d.get("dtype")), cell(d.get("wire_bytes")),
+                cell(d.get("scale_bytes")), cell(d.get("f32_bytes")),
+                cell(d.get("ratio"))]
         out.append("| " + " | ".join(vals) + " |" if fmt == "markdown"
                    else "\t".join(vals))
     return out
